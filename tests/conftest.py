@@ -1,0 +1,26 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+
+SURVEY §4's implication for the TPU build: multi-device behavior must be
+testable without a TPU. All tests run on 8 virtual CPU devices so DP/FSDP/TP
+sharding paths execute real collectives.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's sitecustomize registers the TPU ('axon') backend at
+# interpreter startup and forces jax_platforms; override it back to CPU
+# before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,170 @@
+"""RL-math unit tests against hand-computed / reference-semantics values.
+
+SURVEY §4: "unit-test the RL math (GAE, PPO loss, KL controllers, running
+moments) against hand-computed values" — the reference itself never tests
+these (`ppo_models.py:121-199` is untested upstream).
+"""
+
+import numpy as np
+import pytest
+
+
+def reference_gae(values, rewards, gamma, lam):
+    """Straight numpy transcription of the reference's reversed loop
+    (`ppo_models.py:128-135`) for a single full-length episode."""
+    T = values.shape[1]
+    lastgaelam = 0
+    advantages_reversed = []
+    for t in reversed(range(T)):
+        nextvalues = values[:, t + 1] if t < T - 1 else 0.0
+        delta = rewards[:, t] + gamma * nextvalues - values[:, t]
+        lastgaelam = delta + gamma * lam * lastgaelam
+        advantages_reversed.append(lastgaelam)
+    advantages = np.stack(advantages_reversed[::-1], axis=1)
+    returns = advantages + values
+    return advantages, returns
+
+
+def test_gae_matches_reference_loop():
+    from trlx_tpu.ops.ppo_math import get_advantages_and_returns
+
+    rng = np.random.default_rng(0)
+    B, T = 4, 9
+    values = rng.normal(size=(B, T)).astype(np.float32)
+    rewards = rng.normal(size=(B, T)).astype(np.float32)
+    mask = np.ones((B, T), np.float32)
+
+    adv, ret = get_advantages_and_returns(
+        values, rewards, mask, gamma=0.95, lam=0.9, use_whitening=False
+    )
+    exp_adv, exp_ret = reference_gae(values, rewards, 0.95, 0.9)
+    np.testing.assert_allclose(np.asarray(adv), exp_adv, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), exp_ret, atol=1e-5)
+
+
+def test_gae_masked_equals_truncated():
+    """Advantages of a masked (padded) episode equal those of the truncated
+    episode — pad positions contribute nothing."""
+    from trlx_tpu.ops.ppo_math import get_advantages_and_returns
+
+    rng = np.random.default_rng(1)
+    T, L = 8, 5
+    values = rng.normal(size=(1, T)).astype(np.float32)
+    rewards = rng.normal(size=(1, T)).astype(np.float32)
+    mask = np.zeros((1, T), np.float32)
+    mask[0, :L] = 1
+
+    adv, ret = get_advantages_and_returns(
+        values, rewards, mask, gamma=0.9, lam=0.8, use_whitening=False
+    )
+    exp_adv, exp_ret = reference_gae(values[:, :L], rewards[:, :L], 0.9, 0.8)
+    np.testing.assert_allclose(np.asarray(adv)[0, :L], exp_adv[0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret)[0, :L], exp_ret[0], atol=1e-5)
+    assert np.all(np.asarray(adv)[0, L:] == 0)
+
+
+def test_ppo_loss_hand_values():
+    """Scalar hand-check of the clipped surrogate + clipped value loss."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.ppo_math import ppo_loss
+
+    # single token, ratio = e^{0.5} > 1+0.2 -> clipped branch active for A<0?
+    logprobs = jnp.array([[0.0]])
+    old_logprobs = jnp.array([[-0.5]])
+    values = jnp.array([[1.0]])
+    old_values = jnp.array([[0.5]])
+    advantages = jnp.array([[2.0]])
+    returns = jnp.array([[0.0]])
+    mask = jnp.array([[1.0]])
+
+    loss, stats = ppo_loss(
+        logprobs, values, old_logprobs, old_values, advantages, returns, mask,
+        cliprange=0.2, cliprange_value=0.2, vf_coef=1.0,
+    )
+    ratio = np.exp(0.5)
+    pg1 = -2.0 * ratio
+    pg2 = -2.0 * 1.2
+    exp_pg = max(pg1, pg2)  # pg2 (clipped) is larger: -2.4 > -3.29
+    # value clipped to [0.3, 0.7] -> 0.7; losses (1-0)^2=1 vs (0.7-0)^2=0.49
+    exp_vf = 0.5 * max(1.0, 0.49)
+    np.testing.assert_allclose(float(stats["losses/policy_loss"]), exp_pg, rtol=1e-5)
+    np.testing.assert_allclose(float(stats["losses/value_loss"]), exp_vf, rtol=1e-5)
+    np.testing.assert_allclose(float(loss), exp_pg + exp_vf, rtol=1e-5)
+
+
+def test_ppo_loss_pad_invariance():
+    """Padding must not change the loss (the reference's all-ones-mask bug,
+    SURVEY §8, is explicitly not replicated)."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.ppo_math import ppo_loss
+
+    rng = np.random.default_rng(2)
+    B, T = 2, 6
+    args = [rng.normal(size=(B, T)).astype(np.float32) for _ in range(6)]
+    mask = np.ones((B, T), np.float32)
+
+    loss1, _ = ppo_loss(*[jnp.asarray(a) for a in args], jnp.asarray(mask),
+                        cliprange=0.2, cliprange_value=0.2, vf_coef=0.5)
+
+    pad = rng.normal(size=(B, 3)).astype(np.float32)
+    args_padded = [np.concatenate([a, pad * (i + 1)], axis=1) for i, a in enumerate(args)]
+    mask_padded = np.concatenate([mask, np.zeros((B, 3), np.float32)], axis=1)
+    loss2, _ = ppo_loss(*[jnp.asarray(a) for a in args_padded], jnp.asarray(mask_padded),
+                        cliprange=0.2, cliprange_value=0.2, vf_coef=0.5)
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+
+
+def test_adaptive_kl_controller():
+    from trlx_tpu.ops.ppo_math import adaptive_kl_update
+
+    # kl above target -> coefficient grows, clipped at +20% error
+    new = adaptive_kl_update(0.2, current_kl=12.0, n_steps=100, target=6.0, horizon=10000)
+    assert float(new) == pytest.approx(0.2 * (1 + 0.2 * 100 / 10000))
+    # kl below target -> shrink
+    new = adaptive_kl_update(0.2, current_kl=0.0, n_steps=100, target=6.0, horizon=10000)
+    assert float(new) == pytest.approx(0.2 * (1 - 0.2 * 100 / 10000))
+
+
+def test_running_moments_matches_numpy():
+    """`RunningMoments` tracks std/mean of the concatenated stream
+    (reference `tests/test_ppo.py:49-66`)."""
+    from trlx_tpu.parallel.collectives import RunningMoments
+
+    rng = np.random.default_rng(3)
+    rm = RunningMoments()
+    chunks = [rng.normal(loc=2.0, scale=3.0, size=43) for _ in range(10)]
+    for c in chunks:
+        rm.update(c)
+    allx = np.concatenate(chunks)
+    assert rm.mean == pytest.approx(float(allx.mean()), rel=1e-6)
+    assert rm.std == pytest.approx(float(allx.std(ddof=1)), rel=1e-5)
+
+
+def test_whiten_and_masked_stats():
+    import jax.numpy as jnp
+
+    from trlx_tpu.parallel.collectives import masked_mean, whiten
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(loc=5, scale=2, size=(4, 8)).astype(np.float32)
+    w = np.asarray(whiten(jnp.asarray(x)))
+    assert abs(w.mean()) < 1e-5
+    assert abs(w.std() - 1.0) < 1e-2
+
+    mask = np.zeros((4, 8), np.float32)
+    mask[:, :4] = 1
+    mm = float(masked_mean(jnp.asarray(x), jnp.asarray(mask)))
+    assert mm == pytest.approx(float(x[:, :4].mean()), rel=1e-5)
+
+
+def test_topk_mask():
+    import jax.numpy as jnp
+
+    from trlx_tpu.utils import topk_mask
+
+    xs = jnp.array([[1.0, 5.0, 3.0, 2.0]])
+    out = np.asarray(topk_mask(xs, 2))
+    assert out[0, 1] == 5.0 and out[0, 2] == 3.0
+    assert np.isinf(out[0, 0]) and np.isinf(out[0, 3])

@@ -1,0 +1,9 @@
+"""trlx_tpu — TPU-native RLHF framework.
+
+Brand-new JAX/XLA/pjit implementation of the capabilities of
+danyang-rainbow/trlx-t5 (trlX v0.3.0 + T5/UL2 seq2seq PPO fork): online PPO
+against a user reward function, offline ILQL on reward-labeled datasets, for
+causal LMs (GPT-2 family) and T5/UL2 seq2seq models, sharded over a TPU mesh.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,63 @@
+"""Data types & configuration layer (reference: ``trlx/data/``).
+
+Contains the YAML config system, the method-config registry, and the
+PPO/ILQL experience pytrees. General prompt batch types mirror the
+reference's ``accelerate_base_datatypes.py:8-68``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, List
+
+import flax.struct as struct
+import jax
+
+from trlx_tpu.data.configs import ModelConfig, TrainConfig, TRLConfig
+from trlx_tpu.data.method_configs import MethodConfig, get_method, register_method
+
+
+@dataclass
+class GeneralElement:
+    """Arbitrary data element (reference `data/__init__.py:8-15`)."""
+
+    data: Any
+    metadata: dict = field(default_factory=dict)
+
+
+@dataclass
+class RLElement:
+    """State/action/reward triple (reference `data/__init__.py:18-31`)."""
+
+    state: Any = None
+    action: Any = None
+    reward: float = 0.0
+
+
+@struct.dataclass
+class PromptBatch:
+    """Tokenized prompt batch, left-padded to a fixed length.
+
+    Replaces the reference's ``PromptElement``/``PromptBatch``
+    (`accelerate_base_datatypes.py:8-35`): text stays host-side in the
+    pipeline; this pytree carries only the device arrays.
+    """
+
+    input_ids: jax.Array  # [B, Q] int32, left-padded
+    attention_mask: jax.Array  # [B, Q]
+
+    def __len__(self) -> int:
+        return self.input_ids.shape[0]
+
+
+__all__ = [
+    "TRLConfig",
+    "ModelConfig",
+    "TrainConfig",
+    "MethodConfig",
+    "get_method",
+    "register_method",
+    "GeneralElement",
+    "RLElement",
+    "PromptBatch",
+]

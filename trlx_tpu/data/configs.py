@@ -1,0 +1,184 @@
+"""YAML -> nested dataclass config system.
+
+Re-design of the reference config system (``trlx/data/configs.py:10-190``):
+same three-section schema (``model`` / ``train`` / ``method``), same recursive
+override merge with unknown-key detection (`merge` :10-21, `update` :179-190),
+same method dispatch through the method registry (:153). TPU-specific
+additions: a ``train.mesh`` axis spec (data/fsdp/tensor parallel sizes), a
+compute ``dtype``, and a from-scratch ``model.model_arch`` override so tiny
+synthetic tasks (randomwalks) need no checkpoint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field, fields
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+from trlx_tpu.data.method_configs import MethodConfig, get_method
+
+
+def merge(base: Dict, update: Dict, updated: set) -> Dict:
+    """Recursively merge ``update`` into ``base``, recording touched keys."""
+    for k, v in base.items():
+        if k in update and isinstance(v, dict):
+            base[k] = merge(v, update[k], updated)
+            updated.add(k)
+        elif k in update:
+            base[k] = update[k]
+            updated.add(k)
+    return base
+
+
+def _from_dict_strict(cls, config: Dict[str, Any]):
+    known = {f.name for f in fields(cls)}
+    unknown = set(config) - known
+    if unknown:
+        raise ValueError(f"Unknown keys for {cls.__name__}: {sorted(unknown)}")
+    return cls(**config)
+
+
+@dataclass
+class ModelConfig:
+    """Which policy model to train.
+
+    :param model_path: HF checkpoint directory for weight conversion, or empty
+        for from-scratch init via ``model_arch``.
+    :param tokenizer_path: HF tokenizer path (host-side only).
+    :param model_type: architecture family registered in
+        ``trlx_tpu.models``: ``"gpt2"`` (causal LM) or ``"t5"`` (seq2seq).
+    :param num_layers_unfrozen: train only the top-k transformer blocks
+        (reference `configs.py:42`); -1 trains everything. Also enables the
+        hydra shared-trunk frozen reference branch for PPO.
+    :param model_arch: from-scratch architecture overrides (n_layer, n_embd,
+        n_head, vocab_size, n_positions, ...) when no checkpoint is given.
+    """
+
+    model_path: str = ""
+    tokenizer_path: str = ""
+    model_type: str = "gpt2"
+    num_layers_unfrozen: int = -1
+    model_arch: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return _from_dict_strict(cls, config)
+
+
+@dataclass
+class TrainConfig:
+    """Training loop + distributed layout configuration.
+
+    Core fields mirror the reference ``TrainConfig`` (`configs.py:49-127`);
+    ``mesh`` / ``dtype`` / ``param_dtype`` are TPU-native additions.
+
+    :param mesh: device-mesh axis sizes ``{"dp": -1, "fsdp": 1, "tp": 1}``;
+        -1 consumes all remaining devices on that axis. dp = pure data
+        parallel (replicated params), fsdp = ZeRO-style fully sharded data
+        parallel (param/opt-state sharding, the DeepSpeed-stage equivalent),
+        tp = tensor parallel.
+    """
+
+    total_steps: int = 10000
+    seq_length: int = 64
+    epochs: int = 100
+    batch_size: int = 16
+
+    lr_init: float = 1.0e-4
+    lr_target: float = 1.0e-4
+    opt_betas: Tuple[float, float] = (0.9, 0.95)
+    opt_eps: float = 1.0e-8
+    weight_decay: float = 1.0e-6
+    grad_clip: float = 1.0
+
+    checkpoint_interval: int = 10000
+    eval_interval: int = 100
+    log_interval: int = 1
+
+    pipeline: str = "PromptPipeline"
+    orchestrator: str = "PPOOrchestrator"
+    trainer: str = "PPOTrainer"
+
+    checkpoint_dir: str = "ckpts"
+    project_name: str = "trlx_tpu"
+    run_name: str = ""
+    seed: int = 1000
+
+    mesh: Dict[str, int] = field(default_factory=lambda: {"dp": -1, "fsdp": 1, "tp": 1})
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    rollout_logging_dir: Optional[str] = None
+    tags: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        if "opt_betas" in config:
+            config = dict(config, opt_betas=tuple(config["opt_betas"]))
+        return _from_dict_strict(cls, config)
+
+
+@dataclass
+class TRLConfig:
+    """Top-level config: ``model`` + ``train`` + ``method`` sections."""
+
+    model: ModelConfig
+    train: TrainConfig
+    method: MethodConfig
+
+    @classmethod
+    def load_yaml(cls, yml_fp: str) -> "TRLConfig":
+        with open(yml_fp) as f:
+            config = yaml.safe_load(f)
+        return cls.from_dict(config)
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "TRLConfig":
+        return cls(
+            model=ModelConfig.from_dict(config.get("model", {})),
+            train=TrainConfig.from_dict(config.get("train", {})),
+            method=get_method(config["method"]["name"]).from_dict(
+                {k: v for k, v in config["method"].items()}
+            ),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "model": asdict(self.model),
+            "train": asdict(self.train),
+            "method": self.method.to_dict(),
+        }
+
+    def update(self, **kwargs) -> None:
+        """Apply flat or nested overrides; raise on keys that match nothing.
+
+        Accepts both nested dicts (``{"train": {"lr_init": 1e-5}}``) and flat
+        dotted/bare keys (``lr_init=1e-5``) as the reference's sweep merge
+        does (`configs.py:179-190`).
+        """
+        updates = set()
+        sections = {"model": self.model, "train": self.train, "method": self.method}
+        for k, v in kwargs.items():
+            if k in sections and isinstance(v, dict):
+                unknown = set(v) - set(sections[k].__dict__)
+                if unknown:
+                    raise ValueError(
+                        f"Unknown config keys in {k!r}: {sorted(unknown)}"
+                    )
+                merge(sections[k].__dict__, v, updates)
+                updates.add(k)
+            else:
+                for section in sections.values():
+                    if hasattr(section, k):
+                        setattr(section, k, v)
+                        updates.add(k)
+                        break
+        rest = set(kwargs) - updates
+        if rest:
+            raise ValueError(f"Unknown config keys: {sorted(rest)}")
+
+    def __str__(self):
+        import json
+
+        return "TRLConfig:\n" + json.dumps(self.to_dict(), indent=2)

@@ -1,0 +1,48 @@
+"""ILQL data types as JAX pytrees.
+
+Re-design of ``trlx/data/ilql_types.py:7-49`` (``ILQLElement`` /
+``ILQLBatch``): same fields — tokens, attention mask, per-action rewards,
+state/action gather indices, dones — but batched, padded to static shapes,
+and device-resident so the ILQL loss is one jitted program.
+"""
+
+from __future__ import annotations
+
+import flax.struct as struct
+import jax
+
+
+@struct.dataclass
+class ILQLBatch:
+    """A batch of offline ILQL experience.
+
+    Shapes: B = batch, T = padded sequence length, A = padded number of
+    actions (generated tokens), S = A + 1 states.
+
+    :param input_ids: [B, T] int32 token ids (prompt + response).
+    :param attention_mask: [B, T] 1 on real tokens.
+    :param rewards: [B, A] per-action rewards (terminal-only placement with
+        normalized returns, `offline_orchestrator.py:63-68`).
+    :param states_ixs: [B, S] indices into T of state positions.
+    :param actions_ixs: [B, A] indices into T of action positions.
+    :param dones: [B, S] 0/1 terminal flags per state.
+    :param actions_mask: [B, A] 1 on real (non-padding) actions. TPU addition:
+        the reference encodes padding by repeating the final index; a mask is
+        explicit and keeps reductions exact under static shapes.
+    """
+
+    input_ids: jax.Array
+    attention_mask: jax.Array
+    rewards: jax.Array
+    states_ixs: jax.Array
+    actions_ixs: jax.Array
+    dones: jax.Array
+    actions_mask: jax.Array
+
+    def __len__(self) -> int:
+        return self.input_ids.shape[0]
+
+    def select(self, idx: jax.Array) -> "ILQLBatch":
+        import jax.tree_util as jtu
+
+        return jtu.tree_map(lambda x: x[idx], self)

@@ -1,0 +1,76 @@
+"""Method-config registry: string name -> RL-method config class.
+
+Re-design of the reference registry (``trlx/data/method_configs.py:9-56``).
+Method configs here are *pure-data* dataclasses; the RL math they parameterize
+(GAE, PPO loss, ILQL loss) lives in ``trlx_tpu/ops`` as jit-compiled functions
+taking the config as a static argument — keeping device code functional
+instead of attaching loss methods to config objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+from typing import Any, Callable, Dict
+
+# name (lowercase, no underscores) -> method config class
+_METHODS: Dict[str, type] = {}
+
+
+def register_method(name: str | type = None):
+    """Decorator registering a method config class under a string key."""
+
+    def register_class(cls, key: str):
+        _METHODS[key] = cls
+        setattr(mod, key, cls)
+        return cls
+
+    import sys
+
+    mod = sys.modules[__name__]
+
+    if isinstance(name, type):
+        cls = name
+        return register_class(cls, cls.__name__.lower())
+
+    def wrap(cls):
+        return register_class(cls, (name or cls.__name__).lower())
+
+    return wrap
+
+
+def get_method(name: str) -> type:
+    """Look up a method config class by its registered (case-insensitive) name."""
+    key = name.lower()
+    if key not in _METHODS:
+        # built-in methods register on import (reference does the same via
+        # `trlx/utils/loading.py:1-16` import-time registration)
+        import trlx_tpu.ops.ilql_math  # noqa: F401
+        import trlx_tpu.ops.ppo_math  # noqa: F401
+    if key in _METHODS:
+        return _METHODS[key]
+    raise ValueError(f"Unknown method config: {name!r}. Registered: {sorted(_METHODS)}")
+
+
+@dataclass
+class MethodConfig:
+    """Base config for an RL method.
+
+    :param name: registry key used by YAML `method.name` dispatch.
+    """
+
+    name: str = ""
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        known = {f.name for f in fields(cls)}
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f"Unknown keys for {cls.__name__}: {sorted(unknown)}"
+            )
+        return cls(**config)
+
+    def to_dict(self) -> Dict[str, Any]:
+        from dataclasses import asdict
+
+        return asdict(self)

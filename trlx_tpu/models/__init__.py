@@ -1,0 +1,17 @@
+"""Model families (reference layer 2, ``trlx/model/nn/``).
+
+Each family provides: a frozen arch config, a flax backbone with explicit
+KV-cache decode support, TP partition rules, and an HF-checkpoint converter.
+"""
+
+from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model, init_cache
+from trlx_tpu.models.heads import CausalLMWithValueHead, ILQLHeads, MLPHead
+
+__all__ = [
+    "GPT2Config",
+    "GPT2Model",
+    "init_cache",
+    "CausalLMWithValueHead",
+    "ILQLHeads",
+    "MLPHead",
+]

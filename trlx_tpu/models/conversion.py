@@ -1,0 +1,116 @@
+"""HF checkpoint -> JAX param-pytree conversion.
+
+The reference consumes HF torch checkpoints directly
+(``AutoModelForCausalLM.from_pretrained``, `ppo_models.py:233`;
+``AutoModelForSeq2SeqLM`` bf16, `ppo_models.py:610-615`). The TPU framework
+implements the architectures natively, so checkpoints are converted once,
+host-side, into the flax param tree. Conversion is validated by exact-logit
+parity tests against torch CPU forward (``tests/test_gpt2_parity.py``) —
+SURVEY §7.3 lists this as a hard part.
+
+GPT-2 note: HF ``Conv1D`` stores weights as (in_features, out_features),
+identical to flax ``Dense`` kernels — no transposes anywhere in the GPT-2 map.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.models.gpt2 import GPT2Config
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like -> numpy (host)."""
+    if hasattr(t, "detach"):
+        t = t.detach()
+    if hasattr(t, "float"):
+        # bf16 torch tensors can't go straight to numpy
+        t = t.float()
+    if hasattr(t, "cpu"):
+        t = t.cpu()
+    if hasattr(t, "numpy"):
+        return t.numpy()
+    return np.asarray(t)
+
+
+def gpt2_config_from_hf(path_or_dict) -> GPT2Config:
+    """Read an HF ``config.json`` (path or dict) into :class:`GPT2Config`."""
+    if isinstance(path_or_dict, (str, os.PathLike)):
+        with open(os.path.join(path_or_dict, "config.json")) as f:
+            d = json.load(f)
+    elif hasattr(path_or_dict, "to_dict"):
+        d = path_or_dict.to_dict()
+    else:
+        d = dict(path_or_dict)
+    return GPT2Config(
+        vocab_size=d["vocab_size"],
+        n_positions=d.get("n_positions", 1024),
+        n_embd=d["n_embd"],
+        n_layer=d["n_layer"],
+        n_head=d["n_head"],
+        layer_norm_epsilon=d.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def convert_gpt2_state_dict(
+    state_dict: Mapping[str, Any], config: GPT2Config, dtype: str = "float32"
+) -> Dict[str, Any]:
+    """HF ``GPT2LMHeadModel`` state dict -> ``GPT2Model`` param tree.
+
+    Accepts keys with or without the ``transformer.`` prefix. The LM head is
+    tied to ``wte`` in both frameworks, so only the transformer is mapped.
+    """
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    cast = lambda t: jnp.asarray(_np(t), dtype=jnp.dtype(dtype))
+
+    params: Dict[str, Any] = {
+        "wte": {"embedding": cast(sd["wte.weight"])},
+        "wpe": {"embedding": cast(sd["wpe.weight"])},
+        "ln_f": {"scale": cast(sd["ln_f.weight"]), "bias": cast(sd["ln_f.bias"])},
+    }
+    for i in range(config.n_layer):
+        p = f"h.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": cast(sd[p + "ln_1.weight"]), "bias": cast(sd[p + "ln_1.bias"])},
+            "ln_2": {"scale": cast(sd[p + "ln_2.weight"]), "bias": cast(sd[p + "ln_2.bias"])},
+            "attn": {
+                "c_attn": {
+                    "kernel": cast(sd[p + "attn.c_attn.weight"]),
+                    "bias": cast(sd[p + "attn.c_attn.bias"]),
+                },
+                "c_proj": {
+                    "kernel": cast(sd[p + "attn.c_proj.weight"]),
+                    "bias": cast(sd[p + "attn.c_proj.bias"]),
+                },
+            },
+            "mlp": {
+                "c_fc": {
+                    "kernel": cast(sd[p + "mlp.c_fc.weight"]),
+                    "bias": cast(sd[p + "mlp.c_fc.bias"]),
+                },
+                "c_proj": {
+                    "kernel": cast(sd[p + "mlp.c_proj.weight"]),
+                    "bias": cast(sd[p + "mlp.c_proj.bias"]),
+                },
+            },
+        }
+    return params
+
+
+def load_gpt2_checkpoint(model_path: str, dtype: str = "float32"):
+    """Load an on-disk HF GPT-2 checkpoint -> (GPT2Config, param tree).
+
+    Uses torch only to deserialize weights (host-side); never touches the
+    network (offline-safe).
+    """
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_path, local_files_only=True)
+    config = gpt2_config_from_hf(model.config)
+    params = convert_gpt2_state_dict(model.state_dict(), config, dtype)
+    return config, params

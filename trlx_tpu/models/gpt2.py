@@ -1,0 +1,239 @@
+"""GPT-2-family causal LM, written TPU-first in flax.linen.
+
+Native re-implementation of the architecture behind the reference's
+``GPTHeadWithValueModel`` / ``GPTHydraHeadWithValueModel``
+(``trlx/model/nn/ppo_models.py:225-603``), which wrap HF torch GPT-2. Here
+the transformer itself is a JAX module so that:
+
+- generation runs as one compiled program (prefill + ``lax.scan`` decode over
+  an explicit KV-cache pytree) instead of HF's Python token loop;
+- hidden-dim / head-dim matmuls carry tensor-parallel sharding rules
+  (``partition_rules``) for the mesh's ``tp`` axis;
+- the hydra frozen-branch trick (`ppo_models.py:505-558`) is a plain
+  ``blocks_from`` method re-running the top-k blocks with frozen params.
+
+Weight-compatible with HF GPT-2 checkpoints via
+``trlx_tpu.models.conversion`` (HF Conv1D stores kernels as (in, out), which
+matches flax Dense — conversion is a transpose-free copy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from trlx_tpu.ops.attention import (
+    causal_bias,
+    combine_biases,
+    dot_product_attention,
+    padding_bias,
+)
+
+# KV cache: tuple over layers of {"k": [B, C, H, Dh], "v": [B, C, H, Dh]}
+Cache = Tuple[Dict[str, jax.Array], ...]
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    """Architecture hyperparameters (HF ``GPT2Config`` field names)."""
+
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    dtype: str = "bfloat16"  # compute dtype (MXU path)
+    param_dtype: str = "float32"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "GPT2Config":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+# Tensor-parallel placement: attention/MLP input projections shard the output
+# dim, output projections shard the input dim, so each block needs a single
+# all-reduce of activations (inserted by GSPMD) per sub-layer.
+PARTITION_RULES = [
+    (r"wte/embedding", P(None, "tp")),
+    (r"attn/c_attn/kernel", P(None, "tp")),
+    (r"attn/c_proj/kernel", P("tp", None)),
+    (r"mlp/c_fc/kernel", P(None, "tp")),
+    (r"mlp/c_proj/kernel", P("tp", None)),
+]
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        x = nn.Dense(4 * cfg.n_embd, dtype=dtype, param_dtype=jnp.dtype(cfg.param_dtype), name="c_fc")(x)
+        x = nn.gelu(x, approximate=True)  # GPT-2 uses gelu_new
+        x = nn.Dense(cfg.n_embd, dtype=dtype, param_dtype=jnp.dtype(cfg.param_dtype), name="c_proj")(x)
+        return x
+
+
+class Attention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,  # [B, T, D]
+        bias: Optional[jax.Array],
+        cache_kv: Optional[Dict[str, jax.Array]] = None,
+        cache_index: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        pdtype = jnp.dtype(cfg.param_dtype)
+        B, T, D = x.shape
+        head_dim = cfg.n_embd // cfg.n_head
+
+        qkv = nn.Dense(3 * cfg.n_embd, dtype=dtype, param_dtype=pdtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, cfg.n_head, head_dim)
+        k = k.reshape(B, T, cfg.n_head, head_dim)
+        v = v.reshape(B, T, cfg.n_head, head_dim)
+
+        new_kv = None
+        if cache_kv is not None:
+            # Write this step's keys/values into the capacity buffer at
+            # cache_index, then attend over the whole buffer (invalid
+            # positions are masked by `bias`).
+            k = jax.lax.dynamic_update_slice(cache_kv["k"], k, (0, cache_index, 0, 0))
+            v = jax.lax.dynamic_update_slice(cache_kv["v"], v, (0, cache_index, 0, 0))
+            new_kv = {"k": k, "v": v}
+
+        out = dot_product_attention(q, k, v, bias)
+        out = out.reshape(B, T, cfg.n_embd)
+        out = nn.Dense(cfg.n_embd, dtype=dtype, param_dtype=pdtype, name="c_proj")(out)
+        return out, new_kv
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, bias, cache_kv=None, cache_index=None):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        eps = cfg.layer_norm_epsilon
+        h = nn.LayerNorm(epsilon=eps, dtype=dtype, name="ln_1")(x)
+        attn_out, new_kv = Attention(cfg, name="attn")(h, bias, cache_kv, cache_index)
+        x = x + attn_out
+        h = nn.LayerNorm(epsilon=eps, dtype=dtype, name="ln_2")(x)
+        x = x + MLP(cfg, name="mlp")(h)
+        return x, new_kv
+
+
+class GPT2Model(nn.Module):
+    """GPT-2 transformer with tied-embedding LM head and explicit KV cache.
+
+    Call modes (all jit-safe, static shapes):
+    - training/scoring: ``cache=None`` — full-sequence causal forward.
+    - prefill/decode:   ``cache`` given — keys/values written at
+      ``cache_index`` into fixed-capacity buffers; ``bias`` must mask
+      invalid cache positions (built by the sampler).
+    """
+
+    config: GPT2Config
+
+    def setup(self):
+        cfg = self.config
+        pdtype = jnp.dtype(cfg.param_dtype)
+        self.wte = nn.Embed(cfg.vocab_size, cfg.n_embd, param_dtype=pdtype, name="wte")
+        self.wpe = nn.Embed(cfg.n_positions, cfg.n_embd, param_dtype=pdtype, name="wpe")
+        self.h = [Block(cfg, name=f"h_{i}") for i in range(cfg.n_layer)]
+        self.ln_f = nn.LayerNorm(
+            epsilon=cfg.layer_norm_epsilon, dtype=jnp.dtype(cfg.dtype), name="ln_f"
+        )
+
+    def embed(self, input_ids: jax.Array, position_ids: jax.Array) -> jax.Array:
+        dtype = jnp.dtype(self.config.dtype)
+        return (self.wte(input_ids) + self.wpe(position_ids)).astype(dtype)
+
+    def logits(self, hidden: jax.Array) -> jax.Array:
+        """Tied LM head; logits in float32 for stable softmax/log-softmax."""
+        emb = self.wte.embedding.astype(jnp.dtype(self.config.dtype))
+        return jnp.einsum(
+            "btd,vd->btv", hidden, emb, preferred_element_type=jnp.float32
+        )
+
+    def __call__(
+        self,
+        input_ids: jax.Array,  # [B, T]
+        attention_mask: Optional[jax.Array] = None,  # [B, T] (no cache) / [B, C] (cache)
+        position_ids: Optional[jax.Array] = None,
+        cache: Optional[Cache] = None,
+        cache_index: Optional[jax.Array] = None,
+        start_layer: int = 0,
+        hidden_override: Optional[jax.Array] = None,
+    ):
+        """Returns ``{"logits", "hidden", "cache"}``.
+
+        ``start_layer``/``hidden_override`` serve the hydra frozen branch:
+        re-run blocks ``start_layer..n_layer`` from a saved trunk activation
+        (`ppo_models.py:541-558`).
+        """
+        cfg = self.config
+        T = input_ids.shape[1] if hidden_override is None else hidden_override.shape[1]
+
+        if hidden_override is not None:
+            x = hidden_override.astype(jnp.dtype(cfg.dtype))
+        else:
+            if position_ids is None:
+                if attention_mask is not None and cache is None:
+                    position_ids = jnp.clip(
+                        jnp.cumsum(attention_mask, axis=-1) - 1, 0, None
+                    )
+                else:
+                    position_ids = jnp.arange(T)[None, :]
+            x = self.embed(input_ids, position_ids)
+
+        # Additive attention bias
+        if cache is None:
+            kv_len = T
+            offset = 0
+        else:
+            kv_len = cache[0]["k"].shape[1]
+            offset = cache_index
+        bias = combine_biases(
+            causal_bias(T, kv_len, offset=offset if cache is not None else 0),
+            padding_bias(attention_mask) if attention_mask is not None else None,
+        )
+
+        new_cache: List = []
+        for i in range(start_layer, cfg.n_layer):
+            layer_cache = cache[i] if cache is not None else None
+            x, new_kv = self.h[i](x, bias, layer_cache, cache_index)
+            new_cache.append(new_kv)
+
+        x = self.ln_f(x)
+        logits = self.logits(x)
+        return {
+            "logits": logits,
+            "hidden": x,
+            "cache": tuple(new_cache) if cache is not None else None,
+        }
+
+
+def init_cache(config: GPT2Config, batch_size: int, capacity: int) -> Cache:
+    """Fixed-capacity KV buffers (one compile for the whole decode loop)."""
+    head_dim = config.n_embd // config.n_head
+    shape = (batch_size, capacity, config.n_head, head_dim)
+    dtype = jnp.dtype(config.dtype)
+    return tuple(
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(config.n_layer)
+    )

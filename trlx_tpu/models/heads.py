@@ -1,0 +1,125 @@
+"""Value / Q heads and the policy wrapper modules.
+
+Re-design of the reference's head machinery:
+- ``make_head`` 2-layer MLP (`trlx/model/nn/ppo_models.py:216-222`, bf16 in
+  the fork) -> :class:`MLPHead`.
+- ``GPTHeadWithValueModel`` (`ppo_models.py:225-289`) ->
+  :class:`CausalLMWithValueHead`: backbone + scalar value head, one forward
+  returning logits *and* values (no separate ModelOutput class — outputs are
+  plain dicts of arrays).
+- ``ILQLHeads`` (`trlx/model/nn/ilql_models.py:119-181`) ->
+  :class:`ILQLHeads`: V head + twin Q heads. Target-Q params are NOT module
+  params here — they live as a separate pytree in the ILQL train state and
+  Polyak-sync is a jitted tree op (the ZeRO-3 ``GatheredParameters`` dance at
+  `ilql_models.py:170-181` is unnecessary under GSPMD).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+
+class MLPHead(nn.Module):
+    """``make_head`` equivalent: Dense(2n) -> ReLU -> Dense(out)."""
+
+    hidden_size: int
+    output_size: int = 1
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dtype = jnp.dtype(self.dtype)
+        pdtype = jnp.dtype(self.param_dtype)
+        x = nn.Dense(self.hidden_size * 2, dtype=dtype, param_dtype=pdtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.output_size, dtype=jnp.float32, param_dtype=pdtype, name="fc2")(x)
+        return x
+
+
+class CausalLMWithValueHead(nn.Module):
+    """Causal LM backbone + scalar value head (PPO policy).
+
+    Values are computed in float32 (the head's final layer) — value-loss
+    clipping is sensitive to bf16 rounding.
+    """
+
+    config: GPT2Config
+
+    def setup(self):
+        self.backbone = GPT2Model(self.config, name="transformer")
+        self.v_head = MLPHead(
+            self.config.n_embd,
+            1,
+            dtype=self.config.dtype,
+            param_dtype=self.config.param_dtype,
+            name="v_head",
+        )
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        position_ids: Optional[jax.Array] = None,
+        cache=None,
+        cache_index=None,
+    ):
+        out = self.backbone(
+            input_ids,
+            attention_mask=attention_mask,
+            position_ids=position_ids,
+            cache=cache,
+            cache_index=cache_index,
+        )
+        out["values"] = self.v_head(out["hidden"])[..., 0]
+        return out
+
+    def lm_only(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        position_ids: Optional[jax.Array] = None,
+        cache=None,
+        cache_index=None,
+    ):
+        """Backbone forward without the value head (frozen KL reference)."""
+        return self.backbone(
+            input_ids,
+            attention_mask=attention_mask,
+            position_ids=position_ids,
+            cache=cache,
+            cache_index=cache_index,
+        )
+
+
+class ILQLHeads(nn.Module):
+    """V head + ``n_qs`` Q heads over full vocab (`ilql_models.py:119-136`).
+
+    Heads map hidden state -> per-token values: Q heads output vocab-size
+    action values, V head a scalar state value.
+    """
+
+    config: GPT2Config
+    two_qs: bool = True
+
+    def setup(self):
+        n = self.config.n_embd
+        v = self.config.vocab_size
+        kw = dict(dtype=self.config.dtype, param_dtype=self.config.param_dtype)
+        self.q1_head = MLPHead(n, v, name="q1_head", **kw)
+        if self.two_qs:
+            self.q2_head = MLPHead(n, v, name="q2_head", **kw)
+        self.v_head = MLPHead(n, 1, name="v_head", **kw)
+
+    def __call__(self, hidden: jax.Array) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
+        qs = (self.q1_head(hidden),)
+        if self.two_qs:
+            qs = qs + (self.q2_head(hidden),)
+        vs = self.v_head(hidden)[..., 0]
+        return qs, vs

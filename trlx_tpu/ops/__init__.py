@@ -1,0 +1,26 @@
+"""Device-side ops: attention core, sampling, PPO/ILQL math.
+
+This is where the reference's RL math (`trlx/model/nn/*.py`) and generation
+loops live in TPU form — pure jit-compiled functions over arrays, no
+framework objects on device.
+"""
+
+from trlx_tpu.ops.attention import dot_product_attention
+from trlx_tpu.ops.ppo_math import (
+    PPOConfig,
+    get_advantages_and_returns,
+    kl_controller_update,
+    ppo_loss,
+)
+from trlx_tpu.ops.sampling import GenerationConfig, SampleOutput, make_sampler
+
+__all__ = [
+    "dot_product_attention",
+    "PPOConfig",
+    "get_advantages_and_returns",
+    "ppo_loss",
+    "kl_controller_update",
+    "GenerationConfig",
+    "SampleOutput",
+    "make_sampler",
+]

@@ -1,0 +1,72 @@
+"""Attention core and mask/bias builders.
+
+All attention in the framework funnels through :func:`dot_product_attention`
+so a Pallas flash/decode kernel can replace the XLA einsum path in one place
+(SURVEY §2.9: "Pallas kernels only where XLA fusion is insufficient").
+Masks are additive float biases built once per program by the helpers below —
+models never branch on Python-level conditions inside jit.
+
+Softmax runs in float32 regardless of compute dtype (bf16 logits lose
+~3 decimal digits; the MXU matmuls stay bf16 where the FLOPs are).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # large-negative mask value; avoids -inf NaN propagation in softmax
+
+
+def causal_bias(q_len: int, kv_len: int, offset: int = 0, dtype=jnp.float32) -> jax.Array:
+    """[1, 1, Q, K] additive bias: query i attends kv j iff j <= i + offset.
+
+    ``offset`` is the absolute position of the first query token — used when
+    decoding with a KV cache where queries sit at positions
+    ``offset..offset+Q-1`` of a ``kv_len``-capacity buffer.
+    """
+    q_pos = jnp.arange(q_len)[:, None] + offset
+    k_pos = jnp.arange(kv_len)[None, :]
+    mask = k_pos <= q_pos
+    return jnp.where(mask, 0.0, NEG_INF).astype(dtype)[None, None, :, :]
+
+
+def padding_bias(attention_mask: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """[B, 1, 1, K] additive bias from a 0/1 key-validity mask."""
+    return jnp.where(attention_mask[:, None, None, :] > 0, 0.0, NEG_INF).astype(dtype)
+
+
+def combine_biases(*biases: Optional[jax.Array]) -> Optional[jax.Array]:
+    out = None
+    for b in biases:
+        if b is None:
+            continue
+        out = b if out is None else out + b
+    return out
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, Q, H, D]
+    k: jax.Array,  # [B, K, H, D]
+    v: jax.Array,  # [B, K, H, D]
+    bias: Optional[jax.Array] = None,  # [B or 1, 1 or H, Q, K] additive
+) -> jax.Array:
+    """Standard multi-head attention; returns [B, Q, H, D].
+
+    Logits and softmax in float32; output cast back to q.dtype. XLA fuses
+    the scale/bias/softmax chain between the two MXU matmuls.
+    """
+    depth = q.shape[-1]
+    scale = jax.lax.rsqrt(jnp.float32(depth))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

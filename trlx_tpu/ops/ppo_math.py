@@ -1,0 +1,186 @@
+"""PPO math: config, GAE, clipped surrogate loss, KL controllers.
+
+TPU-native re-design of the reference's ``PPOConfig`` RL math
+(``trlx/model/nn/ppo_models.py:64-199``) and KL controllers (:26-58):
+
+- The config is pure data (registered in the method registry); the math
+  lives in jit-compiled functions taking it as a static argument.
+- GAE's reversed-time Python loop (`ppo_models.py:128-135` — a per-timestep
+  host loop in the reference) becomes a ``lax.scan`` with ``reverse=True``:
+  one fused device program, no host round-trips, differentiable-free.
+- Whitening / means are masked by the real response mask. (The reference
+  feeds an all-ones mask so pad tokens leak into the loss —
+  `accelerate_ppo_model.py:111-116`, SURVEY §8 — a bug we do not replicate.)
+- KL controller updates (`ppo_models.py:26-58`) are pure
+  ``(state, kl) -> state`` functions over a scalar carried in train state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.parallel.collectives import masked_mean, whiten
+
+
+@register_method
+@dataclass
+class PPOConfig(MethodConfig):
+    """PPO hyperparameters (reference `ppo_models.py:104-119`).
+
+    :param ppo_epochs: optimization epochs per rollout batch.
+    :param num_rollouts: rollouts collected per experience phase.
+    :param chunk_size: prompts per generation chunk.
+    :param init_kl_coef: starting KL penalty coefficient.
+    :param target: adaptive-KL target (None -> fixed controller).
+    :param horizon: adaptive-KL horizon.
+    :param gamma / lam: GAE discounting.
+    :param cliprange / cliprange_value: PPO clipping.
+    :param vf_coef: value-loss weight.
+    :param scale_reward: "running" | "ref" | None.
+    :param cliprange_reward: clip scores to +-this after scaling.
+    :param gen_kwargs: generation params (max_new_tokens, top_k, top_p,
+        temperature, do_sample).
+    """
+
+    name: str = "PPOConfig"
+    ppo_epochs: int = 4
+    num_rollouts: int = 128
+    chunk_size: int = 128
+    init_kl_coef: float = 0.2
+    target: Optional[float] = 6.0
+    horizon: int = 10000
+    gamma: float = 1.0
+    lam: float = 0.95
+    cliprange: float = 0.2
+    cliprange_value: float = 0.2
+    vf_coef: float = 1.0
+    scale_reward: Optional[str] = None
+    ref_mean: Optional[float] = None
+    ref_std: Optional[float] = None
+    cliprange_reward: float = 10.0
+    gen_kwargs: Dict[str, Any] = field(
+        default_factory=lambda: dict(max_new_tokens=48, top_k=0, top_p=1.0, do_sample=True)
+    )
+
+
+def get_advantages_and_returns(
+    values: jax.Array,  # [B, R]
+    rewards: jax.Array,  # [B, R]
+    mask: jax.Array,  # [B, R] 1 on real response tokens
+    gamma: float,
+    lam: float,
+    use_whitening: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """GAE as a reversed ``lax.scan`` over time (reference
+    `ppo_models.py:121-139` runs this loop in Python on host tensors).
+
+    Positions beyond the response (mask==0) carry zero advantage; the
+    next-step value is masked so episodes end at the last real token.
+    """
+    mask = mask.astype(values.dtype)
+    values = values * mask
+    rewards = rewards * mask
+
+    next_values = jnp.concatenate([values[:, 1:], jnp.zeros_like(values[:, :1])], axis=1)
+    next_mask = jnp.concatenate([mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
+    deltas = rewards + gamma * next_values * next_mask - values
+
+    def scan_fn(carry, xs):
+        delta_t, mask_t = xs
+        adv = delta_t + gamma * lam * carry * mask_t
+        return adv, adv
+
+    # scan over time axis: transpose to [R, B]
+    _, adv_rev = jax.lax.scan(
+        scan_fn,
+        jnp.zeros_like(deltas[:, 0]),
+        (deltas.T, next_mask.T),
+        reverse=True,
+    )
+    advantages = adv_rev.T * mask
+    returns = advantages + values
+    if use_whitening:
+        advantages = whiten(advantages, mask) * mask
+    return jax.lax.stop_gradient(advantages), jax.lax.stop_gradient(returns)
+
+
+def ppo_loss(
+    logprobs: jax.Array,  # [B, R] new policy logprobs of taken actions
+    values: jax.Array,  # [B, R] new value predictions
+    old_logprobs: jax.Array,  # [B, R] behavior logprobs
+    old_values: jax.Array,  # [B, R] rollout-time values
+    advantages: jax.Array,  # [B, R]
+    returns: jax.Array,  # [B, R]
+    mask: jax.Array,  # [B, R]
+    cliprange: float,
+    cliprange_value: float,
+    vf_coef: float,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Clipped-surrogate PPO loss (reference `ppo_models.py:141-199`).
+
+    Returns (scalar loss, stats dict). All means are masked over real
+    response tokens; under a sharded batch the means are global (GSPMD).
+    """
+    mask = mask.astype(values.dtype)
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+
+    values_clipped = jnp.clip(
+        values, old_values - cliprange_value, old_values + cliprange_value
+    )
+    vf_loss1 = (values - returns) ** 2
+    vf_loss2 = (values_clipped - returns) ** 2
+    vf_loss = 0.5 * jnp.sum(jnp.maximum(vf_loss1, vf_loss2) * mask) / n
+    vf_clipfrac = jnp.sum((vf_loss2 > vf_loss1) * mask) / n
+
+    log_ratio = (logprobs - old_logprobs) * mask
+    ratio = jnp.exp(log_ratio)
+    # k3 estimator of KL(new || old) (reference `ppo_models.py:165-169`)
+    approx_kl = jnp.sum((ratio - 1.0) - log_ratio) / n
+
+    pg_loss1 = -advantages * ratio
+    pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange)
+    pg_loss = jnp.sum(jnp.maximum(pg_loss1, pg_loss2) * mask) / n
+    pg_clipfrac = jnp.sum((pg_loss2 > pg_loss1) * mask) / n
+
+    loss = pg_loss + vf_coef * vf_loss
+
+    stats = {
+        "losses/total_loss": loss,
+        "losses/policy_loss": pg_loss,
+        "losses/value_loss": vf_loss,
+        "policy/approx_kl": approx_kl,
+        "policy/clipfrac": pg_clipfrac,
+        "values/clipfrac": vf_clipfrac,
+        "policy/ratio_mean": jnp.sum(ratio * mask) / n,
+        "values/value_mean": masked_mean(values, mask),
+        "returns/mean": masked_mean(returns, mask),
+        "advantages/mean": masked_mean(advantages, mask),
+    }
+    return loss, stats
+
+
+# --- KL controllers (pure-state versions of `ppo_models.py:26-58`) ---
+
+
+def adaptive_kl_update(
+    kl_coef: jax.Array, current_kl: jax.Array, n_steps: int, target: float, horizon: int
+) -> jax.Array:
+    """Ziegler et al. proportional controller (`ppo_models.py:37-44`)."""
+    proportional_error = jnp.clip(current_kl / target - 1.0, -0.2, 0.2)
+    mult = 1.0 + proportional_error * n_steps / horizon
+    return kl_coef * mult
+
+
+def kl_controller_update(
+    config: PPOConfig, kl_coef, current_kl, n_steps: int
+):
+    """Dispatch adaptive vs fixed by ``config.target`` (None -> fixed,
+    mirroring `accelerate_ppo_model.py:43-48`)."""
+    if config.target is None:
+        return kl_coef
+    return adaptive_kl_update(kl_coef, current_kl, n_steps, config.target, config.horizon)

@@ -1,0 +1,188 @@
+"""Jitted autoregressive sampling: prefill + ``lax.scan`` decode.
+
+Replaces the reference's HF ``generate`` Python token loop
+(``trlx/model/nn/ppo_models.py:620-622``; ILQL's hand-rolled loop
+``ilql_models.py:257-327``) with one compiled XLA program:
+
+- prompts are left-padded to a fixed query length Q, so the last prompt
+  token always sits at buffer slot Q-1 and decode writes slots Q..Q+R-1 —
+  static shapes, zero recompilation across batches;
+- the decode loop is ``lax.scan`` over R steps carrying the KV cache;
+- per-step behavior logprobs (under the *raw* logits, matching the
+  training-time recompute — the reference likewise recomputes logprobs from
+  unfiltered logits, `ppo_orchestrator.py:126-155`) and value estimates are
+  emitted *during* decode, so the orchestrator's separate policy recompute
+  forward (`ppo_orchestrator.py:126-131`) is folded into generation
+  (SURVEY §7.1 design stance).
+
+Sampling controls: temperature, top-k, top-p, greedy; eos early-finish per
+sequence with pad fill (`ilql_models.py:314-325` semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.utils import topk_mask
+
+
+@dataclass(frozen=True)
+class GenerationConfig:
+    """Static generation parameters (hashable: safe as a jit static arg)."""
+
+    max_new_tokens: int = 48
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0  # 1.0 = disabled
+    do_sample: bool = True
+    eos_token_id: int = 50256
+    pad_token_id: int = 50256
+    # seq2seq/forced-BOS support (the fork forces a Chinese BOS token,
+    # `ppo_models.py:620-622`); -1 = disabled
+    forced_bos_token_id: int = -1
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "GenerationConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        d = {k: v for k, v in d.items() if k in known}
+        return cls(**d)
+
+
+@struct.dataclass
+class SampleOutput:
+    """Rollout result, shapes [B, R]; all device-resident."""
+
+    tokens: jax.Array  # sampled response tokens (pad after eos)
+    response_mask: jax.Array  # 1 up to and including the eos token
+    logprobs: jax.Array  # behavior logprobs under raw logits
+    values: jax.Array  # value-head estimates at each decision point
+
+
+def filter_logits(logits: jax.Array, cfg: GenerationConfig) -> jax.Array:
+    """Temperature / top-k / top-p filtering (float32 in, float32 out)."""
+    if cfg.temperature != 1.0:
+        logits = logits / cfg.temperature
+    if cfg.top_k > 0:
+        logits = topk_mask(logits, cfg.top_k)
+    if cfg.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always >= 1 token)
+        cutoff_mask = cum - probs < cfg.top_p
+        kth = jnp.sum(cutoff_mask, axis=-1, keepdims=True)  # tokens kept
+        threshold = jnp.take_along_axis(sorted_logits, kth - 1, axis=-1)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return logits
+
+
+def make_sampler(
+    apply_fn: Callable,
+    init_cache_fn: Callable,
+    gen_config: GenerationConfig,
+    query_length: int,
+    with_values: bool = True,
+):
+    """Build a jittable ``(params, prompt_ids, prompt_mask, rng) ->
+    SampleOutput`` closure.
+
+    ``apply_fn(params, input_ids, attention_mask, position_ids, cache,
+    cache_index)`` must return a dict with "logits", "cache" and (if
+    ``with_values``) "values". ``init_cache_fn(batch, capacity)`` builds the
+    KV buffers.
+    """
+    Q = query_length
+    R = gen_config.max_new_tokens
+    cap = Q + R
+
+    def sampler(params, prompt_ids, prompt_mask, rng) -> SampleOutput:
+        B = prompt_ids.shape[0]
+        n_real = jnp.sum(prompt_mask, axis=-1)  # [B]
+
+        cache = init_cache_fn(B, cap)
+        # prefill: cache validity = prompt mask over slots [0, Q)
+        pad_tail = jnp.zeros((B, R), dtype=prompt_mask.dtype)
+        cache_mask = jnp.concatenate([prompt_mask, pad_tail], axis=1)
+        positions = jnp.clip(jnp.cumsum(prompt_mask, axis=-1) - 1, 0, None)
+        out = apply_fn(
+            params,
+            prompt_ids,
+            attention_mask=cache_mask,
+            position_ids=positions,
+            cache=cache,
+            cache_index=0,
+        )
+        cache = out["cache"]
+        logits_last = out["logits"][:, -1].astype(jnp.float32)  # [B, V]
+        if with_values:
+            value_last = out["values"][:, -1].astype(jnp.float32)
+        else:
+            value_last = jnp.zeros((B,), jnp.float32)
+
+        slot_ids = jnp.arange(cap)[None, :]
+
+        def step(carry, t):
+            cache, logits_last, value_last, finished, rng = carry
+            rng, key = jax.random.split(rng)
+
+            raw_logprobs = jax.nn.log_softmax(logits_last, axis=-1)
+            if gen_config.forced_bos_token_id >= 0:
+                forced = jnp.full((B,), gen_config.forced_bos_token_id, jnp.int32)
+            else:
+                forced = None
+            if gen_config.do_sample:
+                filtered = filter_logits(logits_last, gen_config)
+                token = jax.random.categorical(key, filtered, axis=-1)
+            else:
+                token = jnp.argmax(logits_last, axis=-1)
+            token = token.astype(jnp.int32)
+            if forced is not None:
+                token = jnp.where(t == 0, forced, token)
+            token = jnp.where(finished, gen_config.pad_token_id, token)
+
+            logprob = jnp.take_along_axis(raw_logprobs, token[:, None], axis=-1)[:, 0]
+            live = jnp.logical_not(finished)
+            finished = jnp.logical_or(finished, token == gen_config.eos_token_id)
+
+            ys = (token, live.astype(jnp.int32), logprob, value_last)
+
+            # forward the sampled token at slot Q+t
+            cache_mask_t = (slot_ids <= Q + t).astype(jnp.int32) * jnp.concatenate(
+                [prompt_mask, jnp.ones((B, R), prompt_mask.dtype)], axis=1
+            )
+            out = apply_fn(
+                params,
+                token[:, None],
+                attention_mask=cache_mask_t,
+                position_ids=(n_real + t)[:, None],
+                cache=cache,
+                cache_index=Q + t,
+            )
+            new_logits = out["logits"][:, 0].astype(jnp.float32)
+            new_value = (
+                out["values"][:, 0].astype(jnp.float32)
+                if with_values
+                else jnp.zeros((B,), jnp.float32)
+            )
+            return (out["cache"], new_logits, new_value, finished, rng), ys
+
+        finished0 = jnp.zeros((B,), bool)
+        (_, _, _, _, _), (tokens, mask, logprobs, values) = jax.lax.scan(
+            step,
+            (cache, logits_last, value_last, finished0, rng),
+            jnp.arange(R),
+        )
+        return SampleOutput(
+            tokens=tokens.T,
+            response_mask=mask.T,
+            logprobs=logprobs.T,
+            values=values.T,
+        )
+
+    return sampler

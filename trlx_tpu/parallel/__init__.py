@@ -1,0 +1,51 @@
+"""Parallelism layer: mesh, partitioning, distributed statistics.
+
+The reference's layer-1 distributed substrate (Accelerate/NCCL/DeepSpeed,
+SURVEY §1) rebuilt on ``jax.sharding`` + GSPMD. See ``mesh.py`` for the axis
+conventions, ``partition.py`` for param sharding (ZeRO/TP equivalents), and
+``collectives.py`` for global statistics.
+"""
+
+from trlx_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_FSDP,
+    AXIS_TP,
+    BATCH_AXES,
+    batch_sharding,
+    local_batch_size,
+    make_mesh,
+    replicated,
+)
+from trlx_tpu.parallel.partition import (
+    make_partition_specs,
+    make_shardings,
+    shard_params,
+)
+from trlx_tpu.parallel.collectives import (
+    RunningMoments,
+    flatten_dict,
+    logprobs_from_logits,
+    masked_mean,
+    masked_var,
+    whiten,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_FSDP",
+    "AXIS_TP",
+    "BATCH_AXES",
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "local_batch_size",
+    "make_partition_specs",
+    "make_shardings",
+    "shard_params",
+    "RunningMoments",
+    "whiten",
+    "masked_mean",
+    "masked_var",
+    "logprobs_from_logits",
+    "flatten_dict",
+]

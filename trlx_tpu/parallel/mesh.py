@@ -1,0 +1,94 @@
+"""Device mesh construction and axis conventions.
+
+The TPU-native replacement for the reference's distributed substrate
+(HF Accelerate -> torch.distributed/NCCL/DeepSpeed; SURVEY §2.9). All
+parallelism in this framework is expressed as sharding over one
+``jax.sharding.Mesh`` with three named axes:
+
+- ``dp``   — pure data parallel: params replicated, batch sharded
+             (reference: Accelerate DDP, `accelerate_base_model.py:38`).
+- ``fsdp`` — ZeRO-style fully-sharded data parallel: batch sharded *and*
+             params/optimizer state sharded (reference: DeepSpeed ZeRO
+             stages, `configs/deepspeed_configs/default_configs.yml`).
+- ``tp``   — tensor parallel: hidden/head dimensions sharded (reference has
+             only dormant scaffolding for this, `ppo_models.py:310-312`).
+
+Gradient sync, global statistics, and param gathers all become XLA
+collectives over ICI inserted automatically by GSPMD from these shardings —
+there is no explicit NCCL-equivalent call-site in the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+# Batch axes: data is sharded over both dp and fsdp mesh axes.
+BATCH_AXES = (AXIS_DP, AXIS_FSDP)
+
+
+def make_mesh(
+    mesh_config: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``Mesh`` from ``{"dp": -1, "fsdp": 1, "tp": 1}`` axis sizes.
+
+    Exactly one axis may be -1, meaning "all remaining devices". Multi-host
+    TPU slices work transparently: ``jax.devices()`` enumerates the global
+    device set after ``jax.distributed.initialize``.
+    """
+    mesh_config = dict(mesh_config or {})
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+
+    sizes = {
+        AXIS_DP: mesh_config.get(AXIS_DP, -1),
+        AXIS_FSDP: mesh_config.get(AXIS_FSDP, 1),
+        AXIS_TP: mesh_config.get(AXIS_TP, 1),
+    }
+    unknown = set(mesh_config) - set(sizes)
+    if unknown:
+        raise ValueError(f"Unknown mesh axes: {sorted(unknown)}")
+
+    wildcard = [k for k, v in sizes.items() if v == -1]
+    if len(wildcard) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {wildcard}")
+    fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+    if wildcard:
+        if n % fixed != 0:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes[wildcard[0]] = n // fixed
+    elif fixed != n:
+        raise ValueError(f"Mesh {sizes} needs {fixed} devices, have {n}")
+
+    shape = (sizes[AXIS_DP], sizes[AXIS_FSDP], sizes[AXIS_TP])
+    device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, (AXIS_DP, AXIS_FSDP, AXIS_TP))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [B, ...] data arrays: batch split over dp x fsdp."""
+    return NamedSharding(mesh, P(BATCH_AXES))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_batch_size(mesh: Mesh, global_batch_size: int) -> int:
+    """Per-shard batch size; validates divisibility (reference computes
+    global batch via WORLD_SIZE, `trlx.py:44`)."""
+    n = mesh.shape[AXIS_DP] * mesh.shape[AXIS_FSDP]
+    if global_batch_size % n != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by {n} data shards"
+        )
+    return global_batch_size // n

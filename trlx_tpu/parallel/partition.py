@@ -1,0 +1,114 @@
+"""Parameter partitioning: path-pattern rules -> ``PartitionSpec`` trees.
+
+The GSPMD replacement for DeepSpeed ZeRO param sharding and for tensor
+parallelism (SURVEY §2.9). A model family ships a list of
+``(path_pattern, PartitionSpec)`` rules naming which logical dims ride the
+``tp`` axis; anything not matched falls back to FSDP auto-sharding (largest
+divisible dim over ``fsdp``) or replication. Because sharding is declared on
+the param pytree and passed to ``jax.jit``, XLA inserts all-gathers /
+reduce-scatters automatically — the "ZeRO-3 GatheredParameters" pattern
+(`ilql_models.py:170-181`) has no analogue here; sharded params are used
+directly.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from trlx_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP
+
+# A rule: (regex matched against "/"-joined param path, PartitionSpec)
+Rules = Sequence[Tuple[str, P]]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _auto_fsdp_spec(shape: Tuple[int, ...], fsdp_size: int, taken_axes) -> P:
+    """Shard the largest divisible dim over fsdp; replicate if none fits."""
+    if fsdp_size <= 1 or not shape:
+        return P(*taken_axes) if taken_axes else P()
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    spec = list(taken_axes) + [None] * (len(shape) - len(taken_axes))
+    for i in order:
+        if spec[i] is None and shape[i] % fsdp_size == 0:
+            spec[i] = AXIS_FSDP
+            break
+    while spec and spec[-1] is None:
+        spec.pop()
+    return P(*spec)
+
+
+def make_partition_specs(
+    params: Any,
+    mesh: Mesh,
+    rules: Optional[Rules] = None,
+    min_shard_size: int = 2**14,
+) -> Any:
+    """Produce a PartitionSpec pytree matching ``params``.
+
+    Matching order: first rule whose regex matches the param path wins and
+    contributes its tp placement; the fsdp axis is then layered onto the
+    largest still-unsharded divisible dim (ZeRO-equivalent). Params smaller
+    than ``min_shard_size`` elements stay replicated (biases, layernorms).
+    """
+    rules = list(rules or [])
+    fsdp = mesh.shape[AXIS_FSDP]
+    tp = mesh.shape[AXIS_TP]
+
+    def spec_for(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        name = _path_str(path)
+        base: List = [None] * len(shape)
+        for pattern, pspec in rules:
+            if re.search(pattern, name):
+                for i, ax in enumerate(pspec):
+                    if ax is not None and i < len(shape):
+                        # Apply tp placement only if it divides and tp > 1.
+                        if tp > 1 and shape[i] % tp == 0:
+                            base[i] = ax
+                break
+        size = 1
+        for s in shape:
+            size *= s
+        if fsdp > 1 and size >= min_shard_size:
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for i in order:
+                if base[i] is None and shape[i] % fsdp == 0:
+                    base[i] = AXIS_FSDP
+                    break
+        while base and base[-1] is None:
+            base.pop()
+        return P(*base)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def make_shardings(
+    params: Any, mesh: Mesh, rules: Optional[Rules] = None, **kw
+) -> Any:
+    """PartitionSpec tree -> NamedSharding tree for jit in/out shardings."""
+    specs = make_partition_specs(params, mesh, rules, **kw)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Any, shardings: Any) -> Any:
+    """Place a param pytree onto the mesh per ``shardings``."""
+    return jax.device_put(params, shardings)

@@ -1,0 +1,163 @@
+"""General utilities: seeding, timing, tree ops, top-k masking.
+
+TPU-native re-design of the reference's ``trlx/utils/__init__.py`` (172 LoC:
+set_seed :15-22, Clock :63-101, topk_mask :107-116, tree_map/to_device
+:132-150, filter_non_scalars :153-164, get_git_tag :167-172). Host-side
+helpers stay Python; anything that runs on device is pure jax.numpy so it can
+live inside jitted programs.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+import subprocess
+import time
+from typing import Any, Dict, Iterable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def set_seed(seed: int) -> jax.Array:
+    """Seed host-side RNGs and return the root JAX PRNG key.
+
+    Unlike the reference (which seeds torch/cuda globals), JAX randomness is
+    explicit: the returned key threads through the framework as part of the
+    train state.
+    """
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def flatten(xs: Iterable[Iterable[Any]]) -> List[Any]:
+    """Flatten one level of nesting."""
+    return [item for sub in xs for item in sub]
+
+
+def chunk(xs: List[Any], chunk_size: int) -> List[List[Any]]:
+    """Split ``xs`` into chunks of at most ``chunk_size``."""
+    return [xs[i : i + chunk_size] for i in range(0, len(xs), chunk_size)]
+
+
+def safe_mkdir(path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+
+
+def significant(x: float, ndigits: int = 2) -> float:
+    """Round ``x`` to ``ndigits`` significant figures (for log readability)."""
+    if x == 0 or not math.isfinite(x):
+        return x
+    return round(x, ndigits - int(math.floor(math.log10(abs(x)))) - 1)
+
+
+class Clock:
+    """Wall-clock timer that tracks total time and samples processed.
+
+    Mirrors the reference Clock's API (tick returns ms since last tick;
+    get_stat reports time-per-1000-samples) so trainer timing stats keep the
+    same meaning.
+    """
+
+    def __init__(self):
+        self.start = time.time()
+        self.total_time = 0.0
+        self.total_samples = 0
+
+    def tick(self, samples: int = 0) -> float:
+        end = time.time()
+        delta = end - self.start
+        self.start = end
+        if samples != 0:
+            self.total_time += delta
+            self.total_samples += samples
+        return delta * 1000.0
+
+    def get_stat(self, n_samp: int = 1000, reset: bool = False) -> float:
+        stat = 0.0
+        if self.total_samples > 0:
+            stat = self.total_time * n_samp / self.total_samples
+        if reset:
+            self.total_time = 0.0
+            self.total_samples = 0
+        return stat
+
+
+def topk_mask(xs: jax.Array, k: int) -> jax.Array:
+    """Set all elements outside the top-k of the last axis to -inf.
+
+    Device-side (jit-safe) equivalent of the reference's topk_mask; used by
+    top-k sampling in the jitted decode loop and ILQL generation.
+    """
+    if k >= xs.shape[-1]:
+        return xs
+    kth = jax.lax.top_k(xs, k)[0][..., -1:]
+    return jnp.where(xs < kth, jnp.full_like(xs, -jnp.inf), xs)
+
+
+def tree_map(f, tree: Any) -> Any:
+    """Apply ``f`` to every leaf of a pytree (dict/list/tuple/array)."""
+    return jax.tree_util.tree_map(f, tree)
+
+
+def to_device(tree: Any, device=None) -> Any:
+    """Move a pytree of arrays onto a device (default: first local device)."""
+    return jax.device_put(tree, device)
+
+
+def filter_non_scalars(xs: Dict[str, Any]) -> Dict[str, float]:
+    """Keep only entries castable to float — used before metric logging."""
+    ys = {}
+    for k, v in xs.items():
+        try:
+            ys[k] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return ys
+
+
+def get_git_tag() -> str:
+    """Return `(short-hash, commit-date)` of HEAD for run naming."""
+    try:
+        output = subprocess.check_output(
+            "git log --format='%h/%as' -n1".split(),
+            stderr=subprocess.DEVNULL,
+        )
+        branch = subprocess.check_output(
+            "git rev-parse --abbrev-ref HEAD".split(),
+            stderr=subprocess.DEVNULL,
+        )
+        return f"{branch.decode()[:-1]}/{output.decode()[1:-2]}"
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return "unknown"
+
+
+def rampup_decay_schedule(
+    rampup_steps: int, decay_steps: int, init_lr: float, target_lr: float
+):
+    """Linear warmup then exponential decay, as an optax-compatible schedule.
+
+    Replaces the reference's LambdaLR `rampup_decay`.
+    """
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = target_lr * jnp.minimum(step / jnp.maximum(rampup_steps, 1), 1.0)
+        decay_frac = jnp.maximum(step - rampup_steps, 0.0) / jnp.maximum(
+            decay_steps, 1
+        )
+        decayed = target_lr * jnp.power(
+            jnp.asarray(init_lr / target_lr, jnp.float32), jnp.minimum(decay_frac, 1.0)
+        )
+        return jnp.where(step < rampup_steps, warm, jnp.maximum(decayed, init_lr))
+
+    return schedule
+
+
+def infinite_loader(loader) -> Iterable:
+    """Cycle a finite iterable forever (prompt loaders in rollout collection)."""
+    while True:
+        yield from loader
