@@ -1,0 +1,135 @@
+"""Synthetic random-walks task: shortest-path finding on a random graph.
+
+Same-capability re-design of the reference's fast integration workload
+(``examples/randomwalks/randomwalks.py:13-105``): a small random directed
+graph; the model sees a start node and must generate a walk that reaches the
+goal node; reward is path optimality (shortest length / taken length). Runs
+from scratch (tiny GPT-2 config, no checkpoint, no text tokenizer) — the
+CI-speed end-to-end PPO task (reference README: "toy problem ... training
+isn't guaranteed to work [for all seeds] but saturates in 2-3h").
+
+Token space: node i -> token i; token ``n_nodes`` = eos, ``n_nodes+1`` = pad.
+Prompts are pre-tokenized ``[goal_marker? no — just [start]]`` single-node
+walks; samples decode as space-joined ints (the framework's tokenizer-free
+decode).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.data.configs import TRLConfig
+
+
+def generate_graph(n_nodes: int = 21, p_edge: float = 0.1, seed: int = 1002):
+    """Random directed adjacency with guaranteed outgoing edges and a ring
+    backbone so every node can reach the goal."""
+    rng = np.random.default_rng(seed)
+    adj = rng.random((n_nodes, n_nodes)) < p_edge
+    np.fill_diagonal(adj, False)
+    # ring backbone guarantees strong connectivity
+    for i in range(n_nodes):
+        adj[i, (i + 1) % n_nodes] = True
+    return adj
+
+
+def shortest_lengths(adj: np.ndarray, goal: int = 0) -> np.ndarray:
+    """BFS distances to ``goal`` (following edge direction)."""
+    n = adj.shape[0]
+    dist = np.full(n, np.inf)
+    dist[goal] = 0
+    frontier = [goal]
+    while frontier:
+        nxt = []
+        for v in frontier:
+            preds = np.nonzero(adj[:, v])[0]
+            for u in preds:
+                if dist[u] == np.inf:
+                    dist[u] = dist[v] + 1
+                    nxt.append(u)
+        frontier = nxt
+    return dist
+
+
+def make_task(
+    n_nodes: int = 21,
+    walk_length: int = 9,
+    seed: int = 1002,
+):
+    """Build (reward_fn, metric_fn, prompts, logit_mask, task info)."""
+    goal = 0
+    adj = generate_graph(n_nodes, seed=seed)
+    dists = shortest_lengths(adj, goal)
+
+    def parse_walk(sample: str, start: int) -> List[int]:
+        nodes = [start]
+        for tok in sample.split():
+            t = int(tok)
+            if t >= n_nodes:
+                break
+            nodes.append(t)
+        return nodes
+
+    def walk_score(sample: str, query: str) -> float:
+        start = int(query.split()[-1])
+        walk = parse_walk(sample, start)
+        length = 0.0
+        for u, v in zip(walk[:-1], walk[1:]):
+            if not adj[u, v]:
+                # invalid edge: worst-case penalty (walk never finishes)
+                return 0.0
+            length += 1
+            if v == goal:
+                return float(dists[start] / length)
+        return 0.0
+
+    def reward_fn(samples, queries, response_gt=None):
+        return [walk_score(s, q) for s, q in zip(samples, queries)]
+
+    def metric_fn(samples: List[str]) -> Dict[str, List[float]]:
+        # optimality over eval prompts (in fixed order: one per start node)
+        starts = [i for i in range(1, n_nodes)]
+        vals = [
+            walk_score(s, str(st)) for s, st in zip(samples, starts * 10)
+        ]
+        return {"optimality": vals}
+
+    prompts = [[i] for i in range(1, n_nodes)]
+
+    # adjacency logit mask for ILQL (`examples/randomwalks/ilql_randomwalks.py`)
+    vocab = n_nodes + 2
+    logit_mask = np.zeros((vocab, vocab), dtype=bool)
+    logit_mask[:n_nodes, :n_nodes] = adj
+    return reward_fn, metric_fn, prompts, logit_mask, dict(
+        adj=adj, dists=dists, goal=goal, n_nodes=n_nodes, walk_length=walk_length
+    )
+
+
+def main():
+    import trlx_tpu
+
+    config = TRLConfig.load_yaml(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "configs",
+            "ppo_randomwalks.yml",
+        )
+    )
+    reward_fn, metric_fn, prompts, _, _ = make_task()
+    trlx_tpu.train(
+        reward_fn=reward_fn,
+        metric_fn=metric_fn,
+        prompts=prompts,
+        eval_prompts=prompts,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    main()

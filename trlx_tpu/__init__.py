@@ -7,3 +7,5 @@ causal LMs (GPT-2 family) and T5/UL2 seq2seq models, sharded over a TPU mesh.
 """
 
 __version__ = "0.1.0"
+
+from trlx_tpu.api import train  # noqa: E402,F401

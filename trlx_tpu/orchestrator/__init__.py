@@ -1,0 +1,52 @@
+"""Orchestrators: experience collection (reference layer 6,
+``trlx/orchestrator/``)."""
+
+from __future__ import annotations
+
+import sys
+from abc import ABC, abstractmethod
+from typing import Dict
+
+_ORCHESTRATORS: Dict[str, type] = {}
+
+
+def register_orchestrator(name=None):
+    """Decorator (reference `trlx/orchestrator/__init__.py:12-31`)."""
+
+    def register_class(cls, key: str):
+        _ORCHESTRATORS[key] = cls
+        setattr(sys.modules[__name__], key, cls)
+        return cls
+
+    if isinstance(name, type):
+        return register_class(name, name.__name__.lower())
+
+    def wrap(cls):
+        return register_class(cls, (name or cls.__name__).lower())
+
+    return wrap
+
+
+def get_orchestrator(name: str) -> type:
+    key = name.lower()
+    if key not in _ORCHESTRATORS:
+        import trlx_tpu.orchestrator.ppo_orchestrator  # noqa: F401
+
+        try:
+            import trlx_tpu.orchestrator.offline_orchestrator  # noqa: F401
+        except ImportError:
+            pass
+    if key in _ORCHESTRATORS:
+        return _ORCHESTRATORS[key]
+    raise ValueError(
+        f"Unknown orchestrator: {name!r}. Registered: {sorted(_ORCHESTRATORS)}"
+    )
+
+
+class Orchestrator(ABC):
+    def __init__(self, trainer, pipeline):
+        self.trainer = trainer
+        self.pipeline = pipeline
+
+    @abstractmethod
+    def make_experience(self, num_rollouts: int, iter_count: int = 0): ...

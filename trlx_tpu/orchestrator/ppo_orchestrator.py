@@ -1,0 +1,159 @@
+"""PPO experience collection.
+
+Re-design of ``PPOOrchestrator.make_experience``
+(``trlx/orchestrator/ppo_orchestrator.py:59-196``). The loop keeps the
+reference's semantics — draw prompts, generate, decode, score with the user
+reward fn ``(samples, queries, response_gt)``, scale/clip rewards, per-token
+KL penalty vs the frozen reference model, push to the store — but the
+device/host boundary is redrawn for TPU (SURVEY §7.3 "host/device boundary
+in the rollout loop"):
+
+- generation emits behavior logprobs *and* values in the same compiled
+  program, so the reference's no-grad policy recompute (:126-131) is gone;
+- only token ids cross to host (for detokenization + the user's Python
+  reward fn); rewards go back as one [B] array;
+- the per-token KL penalty + terminal score add (:163-167) is a tiny jitted
+  op on device; rollouts are pushed as batched device pytrees, never as
+  Python lists of CPU tensors (:169-187).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
+from trlx_tpu.data.ppo_types import PPORolloutBatch
+from trlx_tpu.ops.ppo_math import PPOConfig
+from trlx_tpu.parallel.collectives import RunningMoments
+from trlx_tpu.utils import Clock, infinite_loader
+
+
+@register_orchestrator
+class PPOOrchestrator(Orchestrator):
+    """
+    :param trainer: a :class:`PPOTrainer`.
+    :param pipeline: prompt pipeline (queries + optional response_gt).
+    :param reward_fn: ``(samples, queries, response_gt) -> [float]`` — the
+        fork's reward interface (`ppo_orchestrator.py:53-57`,
+        `ul2_RL/rl_ul2.py:71`).
+    :param chunk_size: prompts per generation chunk.
+    """
+
+    def __init__(
+        self,
+        trainer,
+        pipeline,
+        reward_fn: Callable,
+        chunk_size: int = 128,
+    ):
+        super().__init__(trainer, pipeline)
+        self.reward_fn = reward_fn
+        self.chunk_size = chunk_size
+        self._loader = infinite_loader(
+            lambda seed: pipeline.create_loader(
+                chunk_size, shuffle=True, seed=seed, drop_last=False
+            )
+        )
+        # running reward scaling state (`ppo_orchestrator.py:49-51`)
+        self.running = RunningMoments()
+        self.ref_mean = trainer.config.method.ref_mean
+        self.ref_std = trainer.config.method.ref_std
+        # back-reference, as the reference installs (`ppo_orchestrator.py:45`)
+        trainer.orch = self
+
+    def score(self, samples, queries, response_gt):
+        """User reward fn call (host Python; `ppo_orchestrator.py:53-57`)."""
+        return self.reward_fn(
+            samples=samples, queries=queries, response_gt=response_gt
+        )
+
+    def make_experience(self, num_rollouts: int = 128, iter_count: int = 0):
+        method: PPOConfig = self.trainer.config.method
+        clock = Clock()
+        stats = {}
+        collected = 0
+        generate_time = 0.0
+        score_time = 0.0
+        all_scores = []
+
+        while collected < num_rollouts:
+            batch, meta = next(self._loader)
+
+            t = Clock()
+            sample_out = self.trainer.sample(batch.input_ids, batch.attention_mask)
+            generate_time += t.tick() / 1000.0
+
+            texts = self.trainer.decode_responses(
+                sample_out.tokens, sample_out.response_mask
+            )
+            if meta["prompts_text"][0] is not None:
+                queries = meta["prompts_text"]
+            else:
+                queries = self.trainer.decode_queries(
+                    batch.input_ids, batch.attention_mask
+                )
+
+            t = Clock()
+            scores = np.asarray(
+                self.score(texts, queries, meta["response_gt"]), dtype=np.float32
+            )
+            score_time += t.tick() / 1000.0
+            all_scores.append(scores.copy())
+
+            # reward scaling + clip (`ppo_orchestrator.py:96-112`)
+            if method.scale_reward == "running":
+                self.running.update(scores)
+                if self.running.std > 0:
+                    scores = scores / self.running.std
+            elif method.scale_reward == "ref" and self.ref_std:
+                scores = scores / self.ref_std
+            if method.cliprange_reward:
+                scores = np.clip(
+                    scores, -method.cliprange_reward, method.cliprange_reward
+                )
+
+            ref_logprobs = self.trainer.score_ref(
+                batch.input_ids,
+                batch.attention_mask,
+                sample_out.tokens,
+                sample_out.response_mask,
+            )
+            rewards = self.trainer.compute_rewards(
+                sample_out.logprobs,
+                ref_logprobs,
+                sample_out.response_mask,
+                scores,
+            )
+
+            self.trainer.buffer.push(
+                PPORolloutBatch(
+                    query_tokens=batch.input_ids,
+                    query_mask=batch.attention_mask,
+                    response_tokens=sample_out.tokens,
+                    response_mask=sample_out.response_mask,
+                    logprobs=sample_out.logprobs,
+                    values=sample_out.values,
+                    rewards=rewards,
+                )
+            )
+            collected += len(batch)
+
+        exp_time = clock.tick() / 1000.0
+        scores_cat = np.concatenate(all_scores)
+        stats.update(
+            {
+                "exp/generate_time": generate_time,
+                "exp/score_time": score_time,
+                "exp/experience_time": exp_time,
+                "exp/score_mean": float(scores_cat.mean()),
+                "exp/score_std": float(scores_cat.std()),
+                "exp/rollouts_per_sec": collected / max(exp_time, 1e-9),
+                "policy/mean_rollout_kl": self.trainer.mean_kl,
+            }
+        )
+        if getattr(self.trainer, "logger", None) is not None:
+            self.trainer.logger.log(stats, step=iter_count)
+        return stats

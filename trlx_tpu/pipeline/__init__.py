@@ -1,0 +1,73 @@
+"""Pipelines & rollout stores (reference layer 4, ``trlx/pipeline/``).
+
+``BasePipeline`` (`trlx/pipeline/__init__.py:15-47`) was a torch Dataset;
+here a pipeline is a plain host-side container that yields *fixed-shape,
+device-ready* batches — padding happens once at construction, not per
+collate, so every jitted consumer compiles exactly once.
+
+``BaseRolloutStore`` (`trlx/pipeline/__init__.py:50-98`) kept Python lists
+of CPU tensors; the PPO equivalent here (`ppo_buffer.py`) is a
+device-resident pytree of batched arrays (SURVEY §7.1 design stance).
+"""
+
+from __future__ import annotations
+
+import sys
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Dict, Iterable
+
+_DATAPIPELINES: Dict[str, type] = {}
+
+
+def register_datapipeline(name=None):
+    """Decorator registering a pipeline class (reference
+    `pipeline/__init__.py:12-34`)."""
+
+    def register_class(cls, key: str):
+        _DATAPIPELINES[key] = cls
+        setattr(sys.modules[__name__], key, cls)
+        return cls
+
+    if isinstance(name, type):
+        return register_class(name, name.__name__.lower())
+
+    def wrap(cls):
+        return register_class(cls, (name or cls.__name__).lower())
+
+    return wrap
+
+
+def get_datapipeline(name: str) -> type:
+    key = name.lower()
+    if key not in _DATAPIPELINES:
+        import trlx_tpu.pipeline.prompt_pipeline  # noqa: F401
+    if key in _DATAPIPELINES:
+        return _DATAPIPELINES[key]
+    raise ValueError(
+        f"Unknown pipeline: {name!r}. Registered: {sorted(_DATAPIPELINES)}"
+    )
+
+
+class BasePipeline(ABC):
+    """A dataset of prompts; yields device-ready batches."""
+
+    @abstractmethod
+    def __len__(self) -> int: ...
+
+    @abstractmethod
+    def create_loader(self, batch_size: int, shuffle: bool = False) -> Iterable:
+        """Yield batches; each batch is (PromptBatch, host_metadata dict)."""
+        ...
+
+
+class BaseRolloutStore(ABC):
+    """Experience storage consumed by a trainer's optimization loop."""
+
+    @abstractmethod
+    def push(self, exps) -> None: ...
+
+    @abstractmethod
+    def __len__(self) -> int: ...
+
+    @abstractmethod
+    def create_loader(self, batch_size: int, shuffle: bool = False) -> Iterable: ...

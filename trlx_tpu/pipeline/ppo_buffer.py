@@ -1,0 +1,78 @@
+"""Device-resident PPO rollout buffer.
+
+Replaces the reference ``PPORolloutStorage`` (``trlx/pipeline/ppo_pipeline.py
+:11-68``) — a Python list of per-sample CPU tensors flip-padded at collate —
+with an append-of-batches pytree that never leaves the device: rollout
+chunks arrive already batched/padded from the jitted sampler, minibatch
+sampling is a device-side gather, and experience feeds the jitted train step
+with zero host round-trips (SURVEY §7.1).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.ppo_types import PPORolloutBatch, concat_rollouts
+from trlx_tpu.pipeline import BaseRolloutStore
+
+
+class PPORolloutBuffer(BaseRolloutStore):
+    """Accumulates fixed-shape rollout chunks; serves shuffled minibatches."""
+
+    def __init__(self):
+        self._chunks: List[PPORolloutBatch] = []
+        self._full: Optional[PPORolloutBatch] = None
+
+    def push(self, batch: PPORolloutBatch) -> None:
+        self._chunks.append(batch)
+        self._full = None
+
+    def clear_history(self) -> None:
+        """Drop all experience (on-policy refresh, `ppo_pipeline.py:25-26`)."""
+        self._chunks = []
+        self._full = None
+
+    @property
+    def full(self) -> PPORolloutBatch:
+        if self._full is None:
+            if not self._chunks:
+                raise ValueError("rollout buffer is empty")
+            self._full = (
+                self._chunks[0]
+                if len(self._chunks) == 1
+                else concat_rollouts(self._chunks)
+            )
+        return self._full
+
+    def __len__(self) -> int:
+        return sum(c.batch_size for c in self._chunks)
+
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        sharding=None,
+    ) -> Iterator[PPORolloutBatch]:
+        """Yield minibatches as device-side gathers of the full buffer.
+
+        Indices are generated on host (cheap, shapes static); the gather and
+        everything downstream stay on device. ``sharding`` (typically the
+        mesh batch sharding) commits each minibatch's placement so the jitted
+        train step sees its declared in_sharding.
+        """
+        full = self.full
+        n = full.batch_size
+        order = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+        for start in range(0, n - batch_size + 1, batch_size):
+            idx = jnp.asarray(order[start : start + batch_size])
+            mb = full.select(idx)
+            if sharding is not None:
+                mb = jax.device_put(mb, sharding)
+            yield mb

@@ -1,0 +1,128 @@
+"""Prompt pipeline: text (or pre-tokenized) prompts -> fixed-shape batches.
+
+Re-design of the reference ``PromptPipeline`` + ``DataCollatorForRLUL2``
+(``trlx/pipeline/offline_pipeline.py:14-54``): prompts are tokenized and
+**left-padded to a fixed query length once at construction** (the reference
+re-tokenizes to max_length 512 per collate). Left-padding puts the last
+prompt token at a fixed slot, which the jitted sampler requires
+(`ops/sampling.py`). Ground-truth responses (the fork's ``response_gt``
+carried through batches for the reward fn) ride along as host strings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data import PromptBatch
+from trlx_tpu.pipeline import BasePipeline, register_datapipeline
+
+
+def left_pad(seqs: Sequence[Sequence[int]], length: int, pad_id: int):
+    """Left-pad token id lists to ``length``; truncates from the left (keeps
+    the most recent tokens, as the reference tokenizer truncation does)."""
+    ids = np.full((len(seqs), length), pad_id, dtype=np.int32)
+    mask = np.zeros((len(seqs), length), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        s = list(s)[-length:]
+        if s:
+            ids[i, -len(s):] = s
+            mask[i, -len(s):] = 1
+    return ids, mask
+
+
+@register_datapipeline
+class PromptPipeline(BasePipeline):
+    """Holds (prompt, optional response_gt) pairs, pre-tokenized.
+
+    :param prompts: list of strings, or list of token-id lists (synthetic
+        tasks with no text tokenizer, e.g. randomwalks).
+    :param max_prompt_length: fixed query length Q.
+    :param tokenizer: object with ``encode``/``decode``/``pad_token_id``;
+        required when prompts are strings.
+    :param response_gt: optional ground-truth responses (fork's tsv pairs,
+        `trlx/trlx.py:46-54` — here a proper argument, hack removed).
+    """
+
+    def __init__(
+        self,
+        prompts: Union[List[str], List[List[int]]],
+        max_prompt_length: int,
+        tokenizer=None,
+        response_gt: Optional[List[str]] = None,
+    ):
+        if response_gt is not None and len(response_gt) != len(prompts):
+            raise ValueError("response_gt length must match prompts")
+        self.tokenizer = tokenizer
+        self.prompts_text: List[Optional[str]] = []
+        token_lists: List[List[int]] = []
+        for p in prompts:
+            if isinstance(p, str):
+                if tokenizer is None:
+                    raise ValueError("string prompts require a tokenizer")
+                token_lists.append(list(tokenizer.encode(p)))
+                self.prompts_text.append(p)
+            else:
+                token_lists.append(list(p))
+                self.prompts_text.append(None)
+        pad_id = getattr(tokenizer, "pad_token_id", 0) or 0
+        self.input_ids, self.attention_mask = left_pad(
+            token_lists, max_prompt_length, pad_id
+        )
+        self.response_gt = list(response_gt) if response_gt is not None else None
+
+    def __len__(self) -> int:
+        return len(self.input_ids)
+
+    def __getitem__(self, i: int):
+        return self.input_ids[i], self.attention_mask[i]
+
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+    ) -> Iterable[Tuple[PromptBatch, Dict[str, Any]]]:
+        """Yield (PromptBatch, meta) where meta carries host-side strings.
+
+        Batches are always full-size (smaller trailing batches would trigger
+        recompilation); with ``drop_last=False`` the tail batch is padded by
+        repeating earlier rows and marked via ``meta["n_real"]``.
+        """
+        n = len(self)
+        order = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+
+        batches = []
+        for start in range(0, n, batch_size):
+            idx = order[start : start + batch_size]
+            n_real = len(idx)
+            if n_real < batch_size:
+                if drop_last:
+                    continue
+                fill = order[np.arange(batch_size - n_real) % n]
+                idx = np.concatenate([idx, fill])
+            batches.append((idx, n_real))
+
+        def gen():
+            for idx, n_real in batches:
+                batch = PromptBatch(
+                    input_ids=jnp.asarray(self.input_ids[idx]),
+                    attention_mask=jnp.asarray(self.attention_mask[idx]),
+                )
+                meta = {
+                    "n_real": n_real,
+                    "prompts_text": [self.prompts_text[i] for i in idx],
+                    "response_gt": (
+                        [self.response_gt[i] for i in idx]
+                        if self.response_gt is not None
+                        else None
+                    ),
+                }
+                yield batch, meta
+
+        return gen()

@@ -1,0 +1,94 @@
+"""Trainer layer (reference layer 5, ``trlx/model/``).
+
+``BaseRLTrainer`` re-designs ``BaseRLModel`` + ``AccelerateRLModel``
+(``trlx/model/__init__.py:17-144``, ``accelerate_base_model.py:29-325``):
+same responsibilities — own the model/optimizer/schedule, ``learn()`` /
+``evaluate()`` / ``save()`` / ``load()``, log/eval/save cadence — but state
+is an explicit pytree updated by jitted steps on a device mesh, not a
+mutable module wrapped by Accelerate.
+"""
+
+from __future__ import annotations
+
+import sys
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Dict, Iterable, Optional
+
+from trlx_tpu.data.configs import TRLConfig
+
+_TRAINERS: Dict[str, type] = {}
+
+
+def register_trainer(name=None):
+    """Decorator registering a trainer class (reference
+    `trlx/model/__init__.py:14-36` ``register_model``)."""
+
+    def register_class(cls, key: str):
+        _TRAINERS[key] = cls
+        setattr(sys.modules[__name__], key, cls)
+        return cls
+
+    if isinstance(name, type):
+        return register_class(name, name.__name__.lower())
+
+    def wrap(cls):
+        return register_class(cls, (name or cls.__name__).lower())
+
+    return wrap
+
+
+def get_trainer(name: str) -> type:
+    key = name.lower()
+    if key not in _TRAINERS:
+        import trlx_tpu.trainer.ppo_trainer  # noqa: F401
+
+        try:
+            import trlx_tpu.trainer.ilql_trainer  # noqa: F401
+        except ImportError:
+            pass
+    if key in _TRAINERS:
+        return _TRAINERS[key]
+    raise ValueError(f"Unknown trainer: {name!r}. Registered: {sorted(_TRAINERS)}")
+
+
+class BaseRLTrainer(ABC):
+    def __init__(
+        self,
+        config: TRLConfig,
+        reward_fn: Optional[Callable] = None,
+        metric_fn: Optional[Callable] = None,
+        tokenizer=None,
+        logit_mask=None,
+    ):
+        self.config = config
+        self.reward_fn = reward_fn
+        self.metric_fn = metric_fn
+        self.tokenizer = tokenizer
+        self.logit_mask = logit_mask
+        self.orch = None  # back-reference installed by the orchestrator
+        self.eval_pipeline = None
+
+    def add_eval_pipeline(self, pipeline) -> None:
+        """Eval prompts source (reference `accelerate_base_model.py:148-150`)."""
+        self.eval_pipeline = pipeline
+
+    def intervals(self, step: int) -> Dict[str, bool]:
+        """Log/eval/save cadence (reference `trlx/model/__init__.py:135-144`)."""
+        t = self.config.train
+        return {
+            "do_log": step % t.log_interval == 0,
+            "do_eval": step % t.eval_interval == 0,
+            "do_save": step > 0 and step % t.checkpoint_interval == 0,
+        }
+
+    @abstractmethod
+    def learn(self) -> None: ...
+
+    @abstractmethod
+    def evaluate(self) -> Dict[str, Any]: ...
+
+    @abstractmethod
+    def save(self, directory: Optional[str] = None) -> None: ...
+
+    @abstractmethod
+    def load(self, directory: str) -> None: ...

@@ -1,0 +1,90 @@
+"""Shared trainer machinery: train state, optimizer, layer freezing.
+
+Replaces the reference's AdamW + cosine schedule setup
+(``accelerate_base_model.py:94-106``) and ``num_layers_unfrozen`` freezing
+(``ilql_models.py:217-225``). Freezing is an optax mask (frozen params get
+zero updates) — under GSPMD the frozen leaves still shard, they just never
+change, which is the TPU analogue of requires_grad=False.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Tuple
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from trlx_tpu.data.configs import TrainConfig
+
+
+@struct.dataclass
+class TrainState:
+    """Minimal explicit train state; RNG and KL-controller state are threaded
+    by the host loop (they are host-decision values, not gradient state)."""
+
+    params: Any
+    opt_state: Any
+    step: jax.Array  # int32 scalar
+
+
+def unfrozen_param_mask(params: Any, num_layers_unfrozen: int, n_layer: int) -> Any:
+    """True for trainable leaves. With ``num_layers_unfrozen=k > 0``, only the
+    top-k transformer blocks + final layernorm + heads train (reference
+    freezes everything below the branch point)."""
+    if num_layers_unfrozen < 0:
+        return jax.tree_util.tree_map(lambda _: True, params)
+    first_trainable = n_layer - num_layers_unfrozen
+
+    def mask_for(path, leaf):
+        name = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        m = re.search(r"h_(\d+)/", name)
+        if m:
+            return int(m.group(1)) >= first_trainable
+        if "wte" in name or "wpe" in name or "encoder" in name:
+            return False
+        return True  # ln_f, value/Q heads, anything else
+
+    return jax.tree_util.tree_map_with_path(mask_for, params)
+
+
+def make_optimizer(
+    train_config: TrainConfig,
+    total_steps: int,
+    trainable_mask: Optional[Any] = None,
+) -> optax.GradientTransformation:
+    """grad-clip -> AdamW(cosine lr_init->lr_target) [-> freeze mask].
+
+    Reference: AdamW + CosineAnnealingLR from lr_init to lr_target
+    (`accelerate_base_model.py:94-106`).
+    """
+    schedule = optax.cosine_decay_schedule(
+        init_value=train_config.lr_init,
+        decay_steps=max(total_steps, 1),
+        alpha=train_config.lr_target / train_config.lr_init
+        if train_config.lr_init
+        else 1.0,
+    )
+    tx = optax.chain(
+        optax.clip_by_global_norm(train_config.grad_clip),
+        optax.adamw(
+            learning_rate=schedule,
+            b1=train_config.opt_betas[0],
+            b2=train_config.opt_betas[1],
+            eps=train_config.opt_eps,
+            weight_decay=train_config.weight_decay,
+        ),
+    )
+    if trainable_mask is not None:
+        tx = optax.chain(
+            tx,
+            optax.masked(
+                optax.set_to_zero(),
+                jax.tree_util.tree_map(lambda t: not t, trainable_mask),
+            ),
+        )
+    return tx

@@ -1,0 +1,479 @@
+"""PPO trainer: jitted rollout sampling + jitted PPO updates over a mesh.
+
+Re-design of ``AcceleratePPOModel`` (``trlx/model/accelerate_ppo_model.py``)
++ the training loop of ``AccelerateRLModel.learn``
+(``accelerate_base_model.py:224-305``):
+
+- The policy (backbone + value head) lives as a sharded param pytree in an
+  explicit :class:`TrainState`; the frozen KL reference model is a second
+  (backbone-only) param pytree — the fork's full-frozen-copy path
+  (`ppo_orchestrator.py:41-43`) with no second process-visible module.
+- ``loss()`` (`accelerate_ppo_model.py:79-128`) becomes one jitted
+  ``train_step``: full-seq forward, response-slice logprobs/values, GAE
+  (reversed ``lax.scan``), clipped surrogate, grads, optax update — gradient
+  sync is the psum GSPMD inserts for the sharded batch; there is no
+  ``accelerator.backward``.
+- Generation is the compiled sampler from ``ops/sampling.py``; behavior
+  logprobs and values are emitted during decode, so the orchestrator's
+  policy-recompute forward disappears.
+- The KL coefficient is host loop state updated per batch via the adaptive
+  controller (`accelerate_ppo_model.py:136-137`), passed into the reward
+  computation as a device scalar (no retrace).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.ppo_types import PPORolloutBatch
+from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model, PARTITION_RULES, init_cache
+from trlx_tpu.models.heads import CausalLMWithValueHead
+from trlx_tpu.ops.ppo_math import (
+    PPOConfig,
+    get_advantages_and_returns,
+    kl_controller_update,
+    ppo_loss,
+)
+from trlx_tpu.ops.sampling import GenerationConfig, SampleOutput, make_sampler
+from trlx_tpu.parallel import (
+    batch_sharding,
+    logprobs_from_logits,
+    make_partition_specs,
+    make_mesh,
+    replicated,
+)
+from trlx_tpu.pipeline.ppo_buffer import PPORolloutBuffer
+from trlx_tpu.trainer import BaseRLTrainer, register_trainer
+from trlx_tpu.trainer.common import TrainState, make_optimizer, unfrozen_param_mask
+from trlx_tpu.utils import Clock, set_seed
+from trlx_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from trlx_tpu.utils.logging import Logger
+
+
+def get_gpt2_arch(config: TRLConfig):
+    """Model config + (optional) converted checkpoint params for the policy
+    backbone (reference ``get_arch``, `accelerate_ppo_model.py:56-59`)."""
+    model_cfg = config.model
+    overrides = dict(model_cfg.model_arch)
+    overrides.setdefault("dtype", config.train.dtype)
+    overrides.setdefault("param_dtype", config.train.param_dtype)
+    if model_cfg.model_path:
+        from trlx_tpu.models.conversion import load_gpt2_checkpoint
+
+        arch, params = load_gpt2_checkpoint(
+            model_cfg.model_path, dtype=config.train.param_dtype
+        )
+        arch = GPT2Config(
+            **{
+                **arch.__dict__,
+                "dtype": overrides["dtype"],
+                "param_dtype": overrides["param_dtype"],
+            }
+        )
+        return arch, params
+    return GPT2Config.from_dict(overrides), None
+
+
+@register_trainer
+class PPOTrainer(BaseRLTrainer):
+    def __init__(
+        self,
+        config: TRLConfig,
+        reward_fn: Optional[Callable] = None,
+        metric_fn: Optional[Callable] = None,
+        tokenizer=None,
+        logit_mask=None,
+    ):
+        super().__init__(config, reward_fn, metric_fn, tokenizer, logit_mask)
+        method: PPOConfig = config.method
+        train = config.train
+
+        self.mesh = make_mesh(train.mesh)
+        self.rng = set_seed(train.seed)
+
+        if tokenizer is None and config.model.tokenizer_path:
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(
+                config.model.tokenizer_path, local_files_only=True
+            )
+            if self.tokenizer.pad_token_id is None:
+                self.tokenizer.pad_token = self.tokenizer.eos_token
+
+        self.model_config, init_params = get_gpt2_arch(config)
+        self.model = CausalLMWithValueHead(self.model_config)
+        self.backbone = GPT2Model(self.model_config)
+
+        gen_kwargs = dict(method.gen_kwargs)
+        if self.tokenizer is not None:
+            gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
+            gen_kwargs.setdefault(
+                "pad_token_id",
+                self.tokenizer.pad_token_id or self.tokenizer.eos_token_id,
+            )
+        self.gen_config = GenerationConfig.from_dict(gen_kwargs)
+        self.query_length = train.seq_length
+
+        # --- params, shardings, optimizer, state ---
+        self.rng, init_rng = jax.random.split(self.rng)
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        params = self.model.init(init_rng, dummy)["params"]
+        if init_params is not None:
+            params["transformer"] = init_params
+
+        self.param_shardings = self._shardings_for(params)
+        params = jax.device_put(params, self.param_shardings)
+        # frozen KL reference = deep copy of the initial policy backbone
+        # (fork's full-copy path, `ppo_orchestrator.py:41-43`). jnp.copy
+        # forces fresh buffers — the policy's are donated every train step.
+        self.ref_shardings = self._shardings_for(params["transformer"])
+        self.ref_params = jax.device_put(
+            jax.tree_util.tree_map(jnp.copy, params["transformer"]),
+            self.ref_shardings,
+        )
+
+        trainable = unfrozen_param_mask(
+            params, config.model.num_layers_unfrozen, self.model_config.n_layer
+        )
+        self.tx = make_optimizer(train, train.total_steps, trainable)
+        opt_shapes = jax.eval_shape(self.tx.init, params)
+        self.opt_shardings = self._shardings_for(opt_shapes)
+        opt_state = jax.jit(self.tx.init, out_shardings=self.opt_shardings)(params)
+
+        self.state = TrainState(
+            params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)
+        )
+        self.state_shardings = TrainState(
+            params=self.param_shardings,
+            opt_state=self.opt_shardings,
+            step=replicated(self.mesh),
+        )
+
+        self.buffer = PPORolloutBuffer()
+        self.kl_coef = float(method.init_kl_coef)
+        self.mean_kl = 0.0
+        self.approx_reward_mean = 0.0
+
+        self._build_jitted_fns()
+
+    # ------------------------------------------------------------------ #
+
+    def _shardings_for(self, tree):
+        specs = make_partition_specs(tree, self.mesh, PARTITION_RULES)
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def _build_jitted_fns(self):
+        mesh = self.mesh
+        Q = self.query_length
+        method: PPOConfig = self.config.method
+        batch_sh = batch_sharding(mesh)
+        rep = replicated(mesh)
+
+        def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
+                     cache=None, cache_index=None):
+            return self.model.apply(
+                {"params": params},
+                input_ids,
+                attention_mask=attention_mask,
+                position_ids=position_ids,
+                cache=cache,
+                cache_index=cache_index,
+            )
+
+        sampler = make_sampler(
+            apply_fn,
+            functools.partial(init_cache, self.model_config),
+            self.gen_config,
+            Q,
+            with_values=True,
+        )
+        self._sample_jit = jax.jit(
+            sampler,
+            in_shardings=(self.param_shardings, batch_sh, batch_sh, rep),
+            out_shardings=batch_sh,
+        )
+
+        def score_ref(ref_params, q_ids, q_mask, r_ids, r_mask):
+            full_ids = jnp.concatenate([q_ids, r_ids], axis=1)
+            full_mask = jnp.concatenate([q_mask, r_mask], axis=1)
+            out = self.backbone.apply(
+                {"params": ref_params}, full_ids, attention_mask=full_mask
+            )
+            logits = out["logits"][:, Q - 1 : -1]
+            return logprobs_from_logits(logits, r_ids)
+
+        self._score_ref_jit = jax.jit(
+            score_ref,
+            in_shardings=(self.ref_shardings, batch_sh, batch_sh, batch_sh, batch_sh),
+            out_shardings=batch_sh,
+        )
+
+        def compute_rewards(logprobs, ref_logprobs, response_mask, scores, kl_coef):
+            maskf = response_mask.astype(jnp.float32)
+            kl_per_token = (logprobs - ref_logprobs) * maskf
+            rewards = -kl_coef * kl_per_token
+            last = jnp.clip(jnp.sum(response_mask, axis=1) - 1, 0, None)
+            rewards = rewards.at[jnp.arange(rewards.shape[0]), last].add(scores)
+            mean_kl = jnp.mean(jnp.sum(kl_per_token, axis=1))
+            return rewards, mean_kl
+
+        self._compute_rewards_jit = jax.jit(
+            compute_rewards,
+            in_shardings=(batch_sh, batch_sh, batch_sh, batch_sh, rep),
+            out_shardings=(batch_sh, rep),
+        )
+
+        def train_step(state: TrainState, mb: PPORolloutBatch):
+            def loss_fn(params):
+                full_ids = jnp.concatenate([mb.query_tokens, mb.response_tokens], axis=1)
+                full_mask = jnp.concatenate([mb.query_mask, mb.response_mask], axis=1)
+                out = self.model.apply(
+                    {"params": params}, full_ids, attention_mask=full_mask
+                )
+                logits = out["logits"][:, Q - 1 : -1]
+                values = out["values"][:, Q - 1 : -1].astype(jnp.float32)
+                logprobs = logprobs_from_logits(logits, mb.response_tokens)
+                advantages, returns = get_advantages_and_returns(
+                    mb.values, mb.rewards, mb.response_mask, method.gamma, method.lam
+                )
+                loss, stats = ppo_loss(
+                    logprobs,
+                    values,
+                    mb.logprobs,
+                    mb.values,
+                    advantages,
+                    returns,
+                    mb.response_mask,
+                    method.cliprange,
+                    method.cliprange_value,
+                    method.vf_coef,
+                )
+                return loss, stats
+
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            updates, new_opt_state = self.tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+            stats["optimizer/grad_norm"] = optax.global_norm(grads)
+            new_state = TrainState(
+                params=new_params, opt_state=new_opt_state, step=state.step + 1
+            )
+            return new_state, stats
+
+        self._train_step_jit = jax.jit(
+            train_step,
+            in_shardings=(self.state_shardings, batch_sh),
+            out_shardings=(self.state_shardings, rep),
+            donate_argnums=(0,),
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def sample(self, prompt_ids, prompt_mask) -> SampleOutput:
+        """Run the compiled rollout sampler on a prompt batch."""
+        self.rng, key = jax.random.split(self.rng)
+        return self._sample_jit(self.state.params, prompt_ids, prompt_mask, key)
+
+    def score_ref(self, q_ids, q_mask, r_ids, r_mask):
+        return self._score_ref_jit(self.ref_params, q_ids, q_mask, r_ids, r_mask)
+
+    def compute_rewards(self, logprobs, ref_logprobs, response_mask, scores):
+        rewards, mean_kl = self._compute_rewards_jit(
+            logprobs,
+            ref_logprobs,
+            response_mask,
+            jnp.asarray(scores, jnp.float32),
+            jnp.asarray(self.kl_coef, jnp.float32),
+        )
+        self.mean_kl = float(mean_kl)
+        return rewards
+
+    def decode_responses(self, tokens, response_mask) -> List[str]:
+        """Detokenize responses, truncated at their mask (host boundary)."""
+        tokens = np.asarray(tokens)
+        lengths = np.asarray(response_mask).sum(axis=1)
+        out = []
+        for row, n in zip(tokens, lengths):
+            ids = row[: int(n)].tolist()
+            if self.tokenizer is not None:
+                out.append(self.tokenizer.decode(ids, skip_special_tokens=True))
+            else:
+                out.append(" ".join(map(str, ids)))
+        return out
+
+    def decode_queries(self, q_ids, q_mask) -> List[str]:
+        q_ids, q_mask = np.asarray(q_ids), np.asarray(q_mask)
+        out = []
+        for row, m in zip(q_ids, q_mask):
+            ids = row[m.astype(bool)].tolist()
+            if self.tokenizer is not None:
+                out.append(self.tokenizer.decode(ids, skip_special_tokens=True))
+            else:
+                out.append(" ".join(map(str, ids)))
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Sample eval prompts, score, and build a sample table (reference
+        `accelerate_base_model.py:152-222`)."""
+        if self.eval_pipeline is None:
+            return {}
+        clock = Clock()
+        all_queries, all_texts, all_gt = [], [], []
+        # always full chunk-size batches (pad-filled) so the compiled sampler
+        # is reused and batch dims divide the mesh's data shards
+        for batch, meta in self.eval_pipeline.create_loader(
+            self.config.method.chunk_size, shuffle=False, drop_last=False
+        ):
+            out = self.sample(batch.input_ids, batch.attention_mask)
+            n_real = meta["n_real"]
+            texts = self.decode_responses(out.tokens, out.response_mask)[:n_real]
+            if meta["prompts_text"][0] is not None:
+                queries = meta["prompts_text"][:n_real]
+            else:
+                queries = self.decode_queries(batch.input_ids, batch.attention_mask)[:n_real]
+            all_queries += queries
+            all_texts += texts
+            if meta["response_gt"] is not None:
+                all_gt += meta["response_gt"][:n_real]
+        generate_time = clock.tick() / 1000.0
+
+        stats: Dict[str, Any] = {"time/generate": generate_time}
+        columns = ["query", "response"]
+        table = [list(t) for t in zip(all_queries, all_texts)]
+        if self.reward_fn is not None:
+            scores = np.asarray(
+                self.reward_fn(
+                    samples=all_texts,
+                    queries=all_queries,
+                    response_gt=all_gt if all_gt else None,
+                ),
+                dtype=np.float32,
+            )
+            stats["reward/mean"] = float(scores.mean())
+            stats["reward/std"] = float(scores.std())
+            columns.append("reward")
+            table = [row + [float(s)] for row, s in zip(table, scores)]
+        if self.metric_fn is not None:
+            metrics = self.metric_fn(all_texts)
+            for k, v in metrics.items():
+                v = np.asarray(v, dtype=np.float32)
+                stats[f"metrics/{k}"] = float(v.mean())
+        self._last_samples = (columns, table)
+        return stats
+
+    def learn(self) -> Dict[str, Any]:
+        """PPO optimization loop (reference `accelerate_base_model.py:224-305`
+        + `accelerate_ppo_model.py:130-156`): per-epoch buffer pass with
+        ``ppo_epochs`` updates per minibatch, on-policy refresh each epoch."""
+        train = self.config.train
+        method: PPOConfig = self.config.method
+
+        if len(self.buffer) == 0 and self.orch is not None:
+            self.orch.make_experience(method.num_rollouts, 0)
+
+        n_minibatches = max(len(self.buffer) // train.batch_size, 1)
+        total_steps = min(
+            train.total_steps, train.epochs * method.ppo_epochs * n_minibatches
+        )
+
+        logger = Logger(
+            project_name=train.project_name,
+            run_name=train.run_name,
+            config=self.config.to_dict(),
+            tags=train.tags,
+        )
+        self.logger = logger
+
+        stats = self.evaluate()
+        logger.log(stats, step=0)
+        if hasattr(self, "_last_samples"):
+            logger.log_samples(self._last_samples[1], self._last_samples[0], step=0)
+
+        clock = Clock()
+        iter_count = 0
+        final_stats: Dict[str, Any] = {}
+        for epoch in range(train.epochs):
+            for mb in self.buffer.create_loader(
+                train.batch_size,
+                shuffle=True,
+                seed=train.seed + epoch,
+                sharding=batch_sharding(self.mesh),
+            ):
+                for _ in range(method.ppo_epochs):
+                    self.state, step_stats = self._train_step_jit(self.state, mb)
+                    iter_count += 1
+                step_stats["time/batch"] = clock.tick(train.batch_size) / 1000.0
+                # adaptive KL controller (post_backward_callback,
+                # `accelerate_ppo_model.py:136-137`)
+                self.kl_coef = float(
+                    kl_controller_update(
+                        method, self.kl_coef, self.mean_kl, train.batch_size
+                    )
+                )
+                step_stats["policy/kl_coef"] = self.kl_coef
+                step_stats["policy/mean_rollout_kl"] = self.mean_kl
+
+                iv = self.intervals(iter_count)
+                if iv["do_log"]:
+                    logger.log(step_stats, step=iter_count)
+                    final_stats = {
+                        k: float(v) for k, v in step_stats.items()
+                    }
+                if iv["do_eval"]:
+                    eval_stats = self.evaluate()
+                    logger.log(eval_stats, step=iter_count)
+                    final_stats.update(eval_stats)
+                if iv["do_save"]:
+                    self.save()
+                if iter_count >= total_steps:
+                    self.save()
+                    eval_stats = self.evaluate()
+                    logger.log(eval_stats, step=iter_count)
+                    final_stats.update(eval_stats)
+                    logger.finish()
+                    return final_stats
+            # on-policy refresh (post_epoch_callback,
+            # `accelerate_ppo_model.py:130-134`)
+            if self.orch is not None and epoch < train.epochs - 1:
+                self.buffer.clear_history()
+                self.orch.make_experience(method.num_rollouts, iter_count)
+        logger.finish()
+        return final_stats
+
+    # ------------------------------------------------------------------ #
+
+    def save(self, directory: Optional[str] = None) -> None:
+        directory = directory or self.config.train.checkpoint_dir
+        save_checkpoint(
+            directory,
+            self.state,
+            metadata={"kl_coef": self.kl_coef, "mean_kl": self.mean_kl},
+        )
+
+    def load(self, directory: str) -> None:
+        abstract = jax.tree_util.tree_map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            self.state,
+            self.state_shardings,
+        )
+        self.state, meta = load_checkpoint(directory, abstract)
+        self.kl_coef = float(meta.get("kl_coef", self.kl_coef))
+        self.mean_kl = float(meta.get("mean_kl", self.mean_kl))

@@ -158,6 +158,19 @@ def rampup_decay_schedule(
 
 
 def infinite_loader(loader) -> Iterable:
-    """Cycle a finite iterable forever (prompt loaders in rollout collection)."""
+    """Cycle a loader forever (prompt draws in rollout collection).
+
+    ``loader`` is either a reusable iterable or a ``factory(epoch) ->
+    iterable`` (lets pipelines reshuffle per pass). Raises instead of
+    spinning if an iteration yields nothing.
+    """
+    epoch = 0
     while True:
-        yield from loader
+        it = loader(epoch) if callable(loader) else loader
+        yielded = False
+        for item in it:
+            yielded = True
+            yield item
+        if not yielded:
+            raise ValueError("infinite_loader: underlying loader is empty")
+        epoch += 1

@@ -1,0 +1,47 @@
+"""Orbax checkpointing of train-state pytrees + host metadata.
+
+TPU-native replacement for ``accelerator.save_state/load_state``
+(`accelerate_base_model.py:144-146`, SURVEY §5.4): the whole train state
+(params, optimizer state, step) is one pytree saved via Orbax — sharded
+arrays are written/restored per-shard without host gathering — plus a JSON
+sidecar for host-side loop state (iter count, KL coefficient, RNG seed),
+mirroring the reference's Ray `state.json` (`accelerate_base_model.py:232-240`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def save_checkpoint(
+    directory: str,
+    state: Any,
+    metadata: Optional[Dict[str, Any]] = None,
+) -> None:
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(directory, "state"), state, force=True)
+    with open(os.path.join(directory, "host_state.json"), "w") as f:
+        json.dump(metadata or {}, f)
+
+
+def load_checkpoint(
+    directory: str, abstract_state: Any
+) -> Tuple[Any, Dict[str, Any]]:
+    """Restore into the shapes/shardings of ``abstract_state`` (obtain via
+    ``jax.eval_shape`` + shardings, or pass a live state of the right spec)."""
+    directory = os.path.abspath(directory)
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(os.path.join(directory, "state"), abstract_state)
+    meta_path = os.path.join(directory, "host_state.json")
+    metadata: Dict[str, Any] = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            metadata = json.load(f)
+    return state, metadata

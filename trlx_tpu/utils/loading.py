@@ -1,0 +1,7 @@
+"""Registry lookups (reference ``trlx/utils/loading.py:18-52``)."""
+
+from trlx_tpu.orchestrator import get_orchestrator
+from trlx_tpu.pipeline import get_datapipeline as get_pipeline
+from trlx_tpu.trainer import get_trainer
+
+__all__ = ["get_trainer", "get_pipeline", "get_orchestrator"]

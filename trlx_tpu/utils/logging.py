@@ -1,0 +1,79 @@
+"""Metric logging: stdout JSON-lines always, wandb when available.
+
+Re-design of the reference's wandb-only path
+(``Accelerator(log_with="wandb")`` + ``init_trackers``,
+`accelerate_base_model.py:38,78-92`): the tracker here is a thin host-side
+sink — training stats arrive as plain dicts of floats (device scalars are
+pulled once per log step, never inside jitted code). ``debug`` env disables
+wandb as the reference does (`accelerate_base_model.py:88`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+from trlx_tpu.utils import filter_non_scalars, get_git_tag
+
+
+class Logger:
+    def __init__(
+        self,
+        project_name: str = "trlx_tpu",
+        run_name: str = "",
+        config: Optional[Dict[str, Any]] = None,
+        tags=(),
+        use_wandb: Optional[bool] = None,
+        stream=None,
+    ):
+        self.stream = stream or sys.stdout
+        self.start = time.time()
+        self._wandb = None
+        if use_wandb is None:
+            use_wandb = os.environ.get("debug", "") == "" and os.environ.get(
+                "WANDB_DISABLED", ""
+            ) not in ("1", "true")
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(
+                    project=project_name,
+                    name=run_name or None,
+                    config=config,
+                    tags=[*tags, get_git_tag()],
+                    mode=os.environ.get("WANDB_MODE", "offline"),
+                )
+            except Exception:
+                self._wandb = None
+
+    def log(self, stats: Dict[str, Any], step: Optional[int] = None) -> None:
+        scalars = filter_non_scalars(stats)
+        record = {"step": step, "time": round(time.time() - self.start, 2), **scalars}
+        print(json.dumps(record, default=float), file=self.stream, flush=True)
+        if self._wandb is not None:
+            self._wandb.log(scalars, step=step)
+
+    def log_samples(self, rows, columns, step: Optional[int] = None) -> None:
+        """Log generated-sample tables (reference wandb Table,
+        `accelerate_base_model.py:180-221`); stdout shows the first rows."""
+        for row in rows[:4]:
+            printable = {c: str(v)[:120] for c, v in zip(columns, row)}
+            print(json.dumps({"sample": printable}, default=str), file=self.stream)
+        if self._wandb is not None:
+            try:
+                import wandb
+
+                self._wandb.log(
+                    {"samples": wandb.Table(columns=list(columns), rows=[list(r) for r in rows])},
+                    step=step,
+                )
+            except Exception:
+                pass
+
+    def finish(self) -> None:
+        if self._wandb is not None:
+            self._wandb.finish()
