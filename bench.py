@@ -1,0 +1,131 @@
+"""Benchmark: PPO samples/sec/chip on the BASELINE workload shape.
+
+Workload (BASELINE.md): gpt2-small policy (124M, bf16), query length 64,
+128-token... 48-token rollouts (reference test_config: gen len 48, batch 16,
+128 rollouts/phase, 4 ppo_epochs). One full PPO phase = collect 128 rollouts
+(compiled sampler + reward + KL penalty vs frozen ref) + 32 optimizer steps
+(8 minibatches x 4 ppo_epochs). Weights are randomly initialized (zero-egress
+environment: no HF downloads) — identical compute to the pretrained model.
+
+The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is
+computed against a documented single-A100 estimate for torch trlX on this
+workload (HF generate rollouts + DDP updates): ~12 samples/s.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+A100_BASELINE_SAMPLES_PER_SEC = 12.0
+
+def main():
+    import numpy as np
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_orchestrator, get_pipeline, get_trainer
+
+    os.environ.setdefault("WANDB_DISABLED", "1")
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "model_arch": {
+                    "vocab_size": 50257,
+                    "n_positions": 1024,
+                    "n_embd": 768,
+                    "n_layer": 12,
+                    "n_head": 12,
+                },
+            },
+            "train": {
+                "seq_length": 64,
+                "batch_size": 16,
+                "epochs": 3,
+                "total_steps": 10000,
+                "eval_interval": 100000,
+                "checkpoint_interval": 1000000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "bfloat16",
+            },
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 128,
+                "chunk_size": 128,
+                "ppo_epochs": 4,
+                "init_kl_coef": 0.05,
+                "scale_reward": "running",
+                "gen_kwargs": {
+                    "max_new_tokens": 48,
+                    "top_k": 0,
+                    "do_sample": True,
+                    "eos_token_id": 50256,
+                    "pad_token_id": 50256,
+                },
+            },
+        }
+    )
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(100, 40000, size=rng.integers(4, 33)))
+               for _ in range(512)]
+
+    def reward_fn(samples, queries, response_gt=None):
+        # cheap host reward: length-normalized char diversity
+        return [len(set(s)) / max(len(s), 1) for s in samples]
+
+    trainer = get_trainer(config.train.trainer)(config, reward_fn=reward_fn)
+    pipeline = get_pipeline(config.train.pipeline)(
+        prompts, config.train.seq_length
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=reward_fn, chunk_size=config.method.chunk_size
+    )
+
+    from trlx_tpu.parallel.mesh import batch_sharding
+
+    def one_phase():
+        trainer.buffer.clear_history()
+        orch.make_experience(config.method.num_rollouts, 0)
+        for mb in trainer.buffer.create_loader(
+            config.train.batch_size, sharding=batch_sharding(trainer.mesh)
+        ):
+            for _ in range(config.method.ppo_epochs):
+                trainer.state, _ = trainer._train_step_jit(trainer.state, mb)
+        import jax
+
+        jax.block_until_ready(trainer.state.params)
+
+    one_phase()  # warmup: compile sampler + train step
+
+    n_phases = 3
+    start = time.time()
+    for _ in range(n_phases):
+        one_phase()
+    elapsed = time.time() - start
+
+    import jax
+
+    n_chips = len(jax.devices())
+    samples_per_sec = n_phases * config.method.num_rollouts / elapsed
+    per_chip = samples_per_sec / n_chips
+
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_samples_per_sec_per_chip_gpt2s",
+                "value": round(per_chip, 3),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(per_chip / A100_BASELINE_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
