@@ -1,0 +1,67 @@
+"""Offline ILQL on randomwalks (reference
+``examples/randomwalks/ilql_randomwalks.py``): a dataset of random walks with
+optimality rewards, trained offline with the graph adjacency as a
+``logit_mask`` constraining generation to valid edges.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from randomwalks import make_task
+
+from trlx_tpu.data.configs import TRLConfig
+
+
+def make_dataset(task_info, n_walks: int = 1000, seed: int = 0):
+    """Random-policy walks + their optimality rewards, pre-tokenized as
+    (tokens, action_start) pairs."""
+    adj, dists, goal = task_info["adj"], task_info["dists"], task_info["goal"]
+    n_nodes = task_info["n_nodes"]
+    walk_length = task_info["walk_length"]
+    rng = np.random.default_rng(seed)
+
+    samples, rewards = [], []
+    for _ in range(n_walks):
+        start = int(rng.integers(1, n_nodes))
+        node = start
+        walk = [node]
+        for _ in range(walk_length):
+            succs = np.nonzero(adj[node])[0]
+            node = int(rng.choice(succs))
+            walk.append(node)
+            if node == goal:
+                break
+        if walk[-1] == goal:
+            reward = float(dists[start] / (len(walk) - 1))
+        else:
+            reward = 0.0
+        samples.append((walk, 1))  # action_start=1: all moves are actions
+        rewards.append(reward)
+    return samples, rewards
+
+
+def main():
+    import trlx_tpu
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = TRLConfig.load_yaml(os.path.join(repo, "configs", "ilql_randomwalks.yml"))
+    reward_fn, metric_fn, prompts, logit_mask, info = make_task()
+    samples, rewards = make_dataset(info)
+    trlx_tpu.train(
+        dataset=(samples, rewards),
+        metric_fn=metric_fn,
+        eval_prompts=prompts,
+        logit_mask=logit_mask,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -65,7 +65,7 @@ def train(
             raise ValueError("online PPO requires `prompts`")
         pipeline = get_pipeline(config.train.pipeline)(
             prompts,
-            config.train.seq_length,
+            trainer.query_length,
             trainer.tokenizer,
             response_gt=response_gt,
         )
@@ -79,7 +79,7 @@ def train(
 
         eval_pipeline = get_pipeline(config.train.pipeline)(
             eval_prompts if eval_prompts is not None else prompts,
-            config.train.seq_length,
+            trainer.query_length,
             trainer.tokenizer,
         )
         trainer.add_eval_pipeline(eval_pipeline)
@@ -104,7 +104,7 @@ def train(
 
         eval_pipeline = get_pipeline(config.train.pipeline)(
             eval_prompts if eval_prompts is not None else list(samples)[:64],
-            config.train.seq_length,
+            trainer.query_length,
             trainer.tokenizer,
         )
         trainer.add_eval_pipeline(eval_pipeline)

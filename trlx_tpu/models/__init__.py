@@ -5,13 +5,19 @@ KV-cache decode support, TP partition rules, and an HF-checkpoint converter.
 """
 
 from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model, init_cache
-from trlx_tpu.models.heads import CausalLMWithValueHead, ILQLHeads, MLPHead
+from trlx_tpu.models.heads import (
+    CausalLMWithILQLHeads,
+    CausalLMWithValueHead,
+    ILQLHeads,
+    MLPHead,
+)
 
 __all__ = [
     "GPT2Config",
     "GPT2Model",
     "init_cache",
     "CausalLMWithValueHead",
+    "CausalLMWithILQLHeads",
     "ILQLHeads",
     "MLPHead",
 ]

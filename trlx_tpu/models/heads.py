@@ -101,8 +101,11 @@ class CausalLMWithValueHead(nn.Module):
 class ILQLHeads(nn.Module):
     """V head + ``n_qs`` Q heads over full vocab (`ilql_models.py:119-136`).
 
-    Heads map hidden state -> per-token values: Q heads output vocab-size
-    action values, V head a scalar state value.
+    Q heads map action-state hidden -> vocab-size action values; the V head
+    maps state hidden -> a scalar. Target-Q evaluation reuses the same
+    module applied with a *separate target param tree* (see
+    ``CausalLMWithILQLHeads.target_qs``), replacing the reference's frozen
+    ``target_q_heads`` submodules + ZeRO-gather sync (`ilql_models.py:170-181`).
     """
 
     config: GPT2Config
@@ -112,14 +115,71 @@ class ILQLHeads(nn.Module):
         n = self.config.n_embd
         v = self.config.vocab_size
         kw = dict(dtype=self.config.dtype, param_dtype=self.config.param_dtype)
-        self.q1_head = MLPHead(n, v, name="q1_head", **kw)
-        if self.two_qs:
-            self.q2_head = MLPHead(n, v, name="q2_head", **kw)
+        self.q_heads = [
+            MLPHead(n, v, name=f"q{i+1}_head", **kw)
+            for i in range(2 if self.two_qs else 1)
+        ]
         self.v_head = MLPHead(n, 1, name="v_head", **kw)
 
-    def __call__(self, hidden: jax.Array) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
-        qs = (self.q1_head(hidden),)
-        if self.two_qs:
-            qs = qs + (self.q2_head(hidden),)
-        vs = self.v_head(hidden)[..., 0]
-        return qs, vs
+    def q(self, action_hidden: jax.Array) -> Tuple[jax.Array, ...]:
+        return tuple(h(action_hidden) for h in self.q_heads)
+
+    def v(self, state_hidden: jax.Array) -> jax.Array:
+        return self.v_head(state_hidden)[..., 0]
+
+    def __call__(self, action_hidden, state_hidden):
+        return self.q(action_hidden), self.v(state_hidden)
+
+
+class CausalLMWithILQLHeads(nn.Module):
+    """Causal LM + ILQL heads (reference ``CausalLMWithValueHeads``,
+    `ilql_models.py:184-335`).
+
+    Forward gathers hidden states at ``states_ixs``/``actions_ixs``
+    (`ilql_models.py:138-159`) and returns ``(logits, qs, vs,
+    action_hidden)``; target-Q values come from :meth:`target_qs` applied
+    with the target param tree held in the ILQL train state.
+    """
+
+    config: GPT2Config
+    two_qs: bool = True
+
+    def setup(self):
+        self.backbone = GPT2Model(self.config, name="transformer")
+        self.ilql_heads = ILQLHeads(self.config, self.two_qs, name="heads")
+
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        position_ids: Optional[jax.Array] = None,
+        actions_ixs: Optional[jax.Array] = None,
+        states_ixs: Optional[jax.Array] = None,
+        cache=None,
+        cache_index=None,
+    ):
+        out = self.backbone(
+            input_ids,
+            attention_mask=attention_mask,
+            position_ids=position_ids,
+            cache=cache,
+            cache_index=cache_index,
+        )
+        hidden = out["hidden"]
+        if actions_ixs is not None:
+            action_hidden = jnp.take_along_axis(
+                hidden, actions_ixs[..., None], axis=1
+            )
+        else:
+            action_hidden = hidden
+        if states_ixs is not None:
+            state_hidden = jnp.take_along_axis(hidden, states_ixs[..., None], axis=1)
+        else:
+            state_hidden = hidden
+        qs, vs = self.ilql_heads(action_hidden, state_hidden)
+        out.update(qs=qs, vs=vs, action_hidden=action_hidden)
+        return out
+
+    def target_qs(self, action_hidden: jax.Array) -> Tuple[jax.Array, ...]:
+        """Q heads only — apply with ``{"params": {"heads": target_tree}}``."""
+        return self.ilql_heads.q(action_hidden)

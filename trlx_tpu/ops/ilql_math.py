@@ -41,6 +41,9 @@ class ILQLConfig(MethodConfig):
     steps_for_target_q_sync: int = 5
     betas: Tuple[float, ...] = (4.0,)
     two_qs: bool = True
+    # generation params for evaluation decode (reference builds these in
+    # `accelerate_ilql_model.py:87-93`)
+    gen_kwargs: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -1,0 +1,94 @@
+"""Offline (ILQL) experience construction from a reward-labeled dataset.
+
+Re-design of ``OfflineOrchestrator.make_experience``
+(``trlx/orchestrator/offline_orchestrator.py:17-74``): tokenize samples,
+derive action/state indices via ``split_token`` (prompt|response boundary)
+or the all-tokens-are-actions default, normalize returns across the dataset,
+place them terminal-only, and install an :class:`ILQLRolloutStorage` on the
+trainer.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
+from trlx_tpu.pipeline.ilql_storage import ILQLRolloutStorage, build_ilql_batch
+
+
+@register_orchestrator
+class OfflineOrchestrator(Orchestrator):
+    def __init__(self, trainer, pipeline=None, split_token: Optional[str] = None):
+        super().__init__(trainer, pipeline)
+        self.split_token = split_token
+        trainer.orch = self
+
+    def make_experience(self, samples: Sequence, rewards: Sequence[float]):
+        """``samples``: strings (tokenized via the trainer tokenizer),
+        (prompt, response) pairs, or pre-tokenized (token_list, action_start)
+        pairs. ``rewards``: one scalar per sample (terminal)."""
+        tokenizer = self.trainer.tokenizer
+        token_lists: List[List[int]] = []
+        action_starts: List[int] = []
+
+        for sample in samples:
+            if isinstance(sample, str):
+                if self.split_token and self.split_token in sample:
+                    prompt, response = sample.split(self.split_token, 1)
+                    p_toks = list(tokenizer.encode(prompt))
+                    r_toks = list(tokenizer.encode(response))
+                    token_lists.append(p_toks + r_toks)
+                    action_starts.append(max(len(p_toks), 1))
+                else:
+                    toks = list(tokenizer.encode(sample))
+                    token_lists.append(toks)
+                    # bos-prompt assumption: everything after the first token
+                    # is an action (`offline_orchestrator.py:28-49`)
+                    action_starts.append(1)
+            elif (
+                isinstance(sample, (tuple, list))
+                and len(sample) == 2
+                and isinstance(sample[0], str)
+            ):
+                p_toks = list(tokenizer.encode(sample[0]))
+                r_toks = list(tokenizer.encode(sample[1]))
+                token_lists.append(p_toks + r_toks)
+                action_starts.append(max(len(p_toks), 1))
+            else:
+                toks, start = sample
+                token_lists.append([int(t) for t in toks])
+                action_starts.append(int(start))
+
+        rewards = np.asarray(list(rewards), dtype=np.float32)
+        print(
+            f"[offline] {len(token_lists)} samples, "
+            f"reward mean {rewards.mean():.3f} std {rewards.std():.3f}"
+        )
+        # normalize returns across the dataset (`offline_orchestrator.py:63-64`)
+        std = rewards.std()
+        if std > 0:
+            rewards = (rewards - rewards.mean()) / std
+
+        # terminal-only placement (`offline_orchestrator.py:66-68`)
+        rewards_per_sample = []
+        for toks, start, r in zip(token_lists, action_starts, rewards):
+            n_actions = max(len(toks) - max(start, 1), 1)
+            rs = [0.0] * n_actions
+            rs[-1] = float(r)
+            rewards_per_sample.append(rs)
+
+        pad_id = 0
+        if tokenizer is not None and tokenizer.pad_token_id is not None:
+            pad_id = tokenizer.pad_token_id
+        batch = build_ilql_batch(
+            token_lists,
+            action_starts,
+            rewards_per_sample,
+            pad_token_id=pad_id,
+            max_length=self.trainer.config.train.seq_length,
+        )
+        store = ILQLRolloutStorage(batch)
+        self.trainer.store = store
+        return store

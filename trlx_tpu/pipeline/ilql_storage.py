@@ -1,0 +1,117 @@
+"""ILQL rollout storage: padded offline experience arrays.
+
+Re-design of ``ILQLRolloutStorage`` (``trlx/pipeline/offline_pipeline.py:57-112``):
+the reference keeps six parallel lists of per-sample tensors and pads at
+collate; here everything is padded once into one :class:`ILQLBatch` of
+static-shape arrays, and minibatches are device gathers (same pattern as the
+PPO buffer).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.ilql_types import ILQLBatch
+from trlx_tpu.pipeline import BaseRolloutStore
+
+
+def build_ilql_batch(
+    token_lists: Sequence[Sequence[int]],
+    action_starts: Sequence[int],
+    rewards_per_sample: Sequence[Sequence[float]],
+    pad_token_id: int = 0,
+    max_length: int | None = None,
+) -> ILQLBatch:
+    """Pack tokenized samples into a padded ILQLBatch.
+
+    For a sample of length L with actions starting at token index ``s``
+    (i.e. tokens ``s..L-1`` are the response/actions):
+    - ``actions_ixs``: hidden-state indices ``s-1 .. L-2`` (the state *before*
+      each action token);
+    - ``states_ixs``: ``s-1 .. L-1`` (actions_ixs + final state);
+    - ``dones``: 1 for every state except the final one (0 = terminal), the
+      reference's convention (`offline_orchestrator.py:28-49`).
+    """
+    n = len(token_lists)
+    T = max_length or max(len(t) for t in token_lists)
+    A = max(len(t) - max(s, 1) for t, s in zip(token_lists, action_starts))
+    A = max(A, 1)
+    S = A + 1
+
+    input_ids = np.full((n, T), pad_token_id, np.int32)
+    attention_mask = np.zeros((n, T), np.int32)
+    rewards = np.zeros((n, A), np.float32)
+    actions_ixs = np.zeros((n, A), np.int32)
+    states_ixs = np.zeros((n, S), np.int32)
+    dones = np.zeros((n, S), np.int32)
+    actions_mask = np.zeros((n, A), np.int32)
+
+    for i, (toks, s, rs) in enumerate(
+        zip(token_lists, action_starts, rewards_per_sample)
+    ):
+        toks = list(toks)[:T]
+        L = len(toks)
+        s = max(min(s, L - 1), 1)
+        input_ids[i, :L] = toks
+        attention_mask[i, :L] = 1
+        n_actions = L - s
+        ixs = np.arange(s - 1, L - 1)
+        actions_ixs[i, :n_actions] = ixs
+        # pad action indices by repeating the last (masked out of the loss)
+        actions_ixs[i, n_actions:] = ixs[-1] if n_actions else 0
+        states_ixs[i, : n_actions + 1] = np.arange(s - 1, L)
+        states_ixs[i, n_actions + 1 :] = L - 1
+        dones[i, :n_actions] = 1  # all but final state non-terminal
+        actions_mask[i, :n_actions] = 1
+        rs = list(rs)
+        if len(rs) > n_actions > 0:
+            # truncation dropped trailing actions: fold their rewards onto
+            # the last kept action so the total return is preserved
+            tail = float(np.sum(rs[n_actions - 1 :]))
+            rs = rs[: n_actions - 1] + [tail]
+        rewards[i, : len(rs)] = rs
+
+    return ILQLBatch(
+        input_ids=jnp.asarray(input_ids),
+        attention_mask=jnp.asarray(attention_mask),
+        rewards=jnp.asarray(rewards),
+        states_ixs=jnp.asarray(states_ixs),
+        actions_ixs=jnp.asarray(actions_ixs),
+        dones=jnp.asarray(dones),
+        actions_mask=jnp.asarray(actions_mask),
+    )
+
+
+class ILQLRolloutStorage(BaseRolloutStore):
+    """Holds one packed ILQLBatch; serves sharded shuffled minibatches."""
+
+    def __init__(self, batch: ILQLBatch):
+        self.batch = batch
+
+    def push(self, exps) -> None:
+        raise NotImplementedError("offline storage is built once")
+
+    def __len__(self) -> int:
+        return len(self.batch)
+
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        sharding=None,
+    ) -> Iterator[ILQLBatch]:
+        n = len(self)
+        order = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+        for start in range(0, n - batch_size + 1, batch_size):
+            idx = jnp.asarray(order[start : start + batch_size])
+            mb = self.batch.select(idx)
+            if sharding is not None:
+                mb = jax.device_put(mb, sharding)
+            yield mb

@@ -1,0 +1,332 @@
+"""ILQL trainer: offline Q-learning with jitted updates and in-graph
+target-network sync.
+
+Re-design of ``AccelerateILQLModel`` (``trlx/model/accelerate_ilql_model.py``):
+
+- The target-Q param tree is part of the train state; the Polyak sync every
+  ``steps_for_target_q_sync`` steps (`accelerate_ilql_model.py:54-56`,
+  `ilql_models.py:161-181`) is a ``lax.cond`` *inside* the jitted train step
+  — no host round-trip, no ZeRO gather (sharded params sync elementwise).
+- Evaluation generation uses the compiled sampler with advantage-shifted
+  logits ``log pi_beta + beta * (min_target_Q - V)`` and optional per-token
+  ``logit_mask`` (the reference's hand-rolled decode,
+  `ilql_models.py:257-327`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.ilql_types import ILQLBatch
+from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model, PARTITION_RULES, init_cache
+from trlx_tpu.models.heads import CausalLMWithILQLHeads
+from trlx_tpu.ops.ilql_math import ILQLConfig, ilql_loss, polyak_update
+from trlx_tpu.ops.sampling import GenerationConfig, make_sampler
+from trlx_tpu.parallel import (
+    batch_sharding,
+    make_partition_specs,
+    make_mesh,
+    replicated,
+)
+from trlx_tpu.trainer import BaseRLTrainer, register_trainer
+from trlx_tpu.trainer.common import make_optimizer, unfrozen_param_mask
+from trlx_tpu.trainer.ppo_trainer import get_gpt2_arch
+from trlx_tpu.utils import Clock, set_seed
+from trlx_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from trlx_tpu.utils.logging import Logger
+
+
+@struct.dataclass
+class ILQLTrainState:
+    params: Any
+    target_q_params: Any  # copy of the q-head subtree of params["heads"]
+    opt_state: Any
+    step: jax.Array
+
+
+def _q_subtree(heads_params: Dict) -> Dict:
+    return {k: v for k, v in heads_params.items() if k.startswith("q")}
+
+
+@register_trainer
+class ILQLTrainer(BaseRLTrainer):
+    def __init__(
+        self,
+        config: TRLConfig,
+        reward_fn: Optional[Callable] = None,
+        metric_fn: Optional[Callable] = None,
+        tokenizer=None,
+        logit_mask=None,
+    ):
+        super().__init__(config, reward_fn, metric_fn, tokenizer, logit_mask)
+        method: ILQLConfig = config.method
+        train = config.train
+
+        self.mesh = make_mesh(train.mesh)
+        self.rng = set_seed(train.seed)
+
+        if tokenizer is None and config.model.tokenizer_path:
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(
+                config.model.tokenizer_path, local_files_only=True
+            )
+            if self.tokenizer.pad_token_id is None:
+                self.tokenizer.pad_token = self.tokenizer.eos_token
+
+        self.model_config, init_params = get_gpt2_arch(config)
+        self.model = CausalLMWithILQLHeads(self.model_config, two_qs=method.two_qs)
+
+        gen_kwargs = {"max_new_tokens": 48, "do_sample": True, "top_k": 20}
+        if self.tokenizer is not None:
+            gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
+            gen_kwargs.setdefault(
+                "pad_token_id",
+                self.tokenizer.pad_token_id or self.tokenizer.eos_token_id,
+            )
+        gen_kwargs.update(getattr(method, "gen_kwargs", {}) or {})
+        self.gen_config = GenerationConfig.from_dict(gen_kwargs)
+        self.beta = float(method.betas[0])
+        self.query_length = min(
+            train.seq_length, max(train.seq_length - self.gen_config.max_new_tokens, 1)
+        )
+
+        # --- params / state ---
+        self.rng, init_rng = jax.random.split(self.rng)
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        params = self.model.init(init_rng, dummy)["params"]
+        if init_params is not None:
+            params["transformer"] = init_params
+
+        self.param_shardings = self._shardings_for(params)
+        params = jax.device_put(params, self.param_shardings)
+        target_q = jax.tree_util.tree_map(jnp.copy, _q_subtree(params["heads"]))
+        self.target_shardings = self._shardings_for(target_q)
+        target_q = jax.device_put(target_q, self.target_shardings)
+
+        trainable = unfrozen_param_mask(
+            params, config.model.num_layers_unfrozen, self.model_config.n_layer
+        )
+        self.tx = make_optimizer(train, train.total_steps, trainable)
+        opt_shapes = jax.eval_shape(self.tx.init, params)
+        self.opt_shardings = self._shardings_for(opt_shapes)
+        opt_state = jax.jit(self.tx.init, out_shardings=self.opt_shardings)(params)
+
+        self.state = ILQLTrainState(
+            params=params,
+            target_q_params=target_q,
+            opt_state=opt_state,
+            step=jnp.zeros((), jnp.int32),
+        )
+        self.state_shardings = ILQLTrainState(
+            params=self.param_shardings,
+            target_q_params=self.target_shardings,
+            opt_state=self.opt_shardings,
+            step=replicated(self.mesh),
+        )
+
+        self.store = None  # installed by OfflineOrchestrator
+        self._build_jitted_fns()
+
+    def _shardings_for(self, tree):
+        specs = make_partition_specs(tree, self.mesh, PARTITION_RULES)
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def _build_jitted_fns(self):
+        method: ILQLConfig = self.config.method
+        batch_sh = batch_sharding(self.mesh)
+        rep = replicated(self.mesh)
+        logit_mask = (
+            jnp.asarray(self.logit_mask) if self.logit_mask is not None else None
+        )
+
+        def train_step(state: ILQLTrainState, mb: ILQLBatch):
+            def loss_fn(params):
+                out = self.model.apply(
+                    {"params": params},
+                    mb.input_ids,
+                    attention_mask=mb.attention_mask,
+                    actions_ixs=mb.actions_ixs,
+                    states_ixs=mb.states_ixs,
+                )
+                target_qs = self.model.apply(
+                    {"params": {"heads": state.target_q_params}},
+                    out["action_hidden"],
+                    method=CausalLMWithILQLHeads.target_qs,
+                )
+                return ilql_loss(
+                    out["logits"], out["qs"], target_qs, out["vs"], mb, method
+                )
+
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            updates, new_opt_state = self.tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+            new_step = state.step + 1
+            # in-graph Polyak target sync (`ilql_models.py:161-181`)
+            new_target = jax.lax.cond(
+                new_step % method.steps_for_target_q_sync == 0,
+                lambda: polyak_update(
+                    _q_subtree(new_params["heads"]),
+                    state.target_q_params,
+                    method.alpha,
+                ),
+                lambda: state.target_q_params,
+            )
+            stats["optimizer/grad_norm"] = optax.global_norm(grads)
+            return (
+                ILQLTrainState(
+                    params=new_params,
+                    target_q_params=new_target,
+                    opt_state=new_opt_state,
+                    step=new_step,
+                ),
+                stats,
+            )
+
+        self._train_step_jit = jax.jit(
+            train_step,
+            in_shardings=(self.state_shardings, batch_sh),
+            out_shardings=(self.state_shardings, rep),
+            donate_argnums=(0,),
+        )
+
+        # --- advantage-shifted sampler (`ilql_models.py:257-327`) ---
+        def sample_apply(bundle, input_ids, attention_mask=None, position_ids=None,
+                         cache=None, cache_index=None):
+            out = self.model.apply(
+                {"params": bundle["params"]},
+                input_ids,
+                attention_mask=attention_mask,
+                position_ids=position_ids,
+                cache=cache,
+                cache_index=cache_index,
+            )
+            target_qs = self.model.apply(
+                {"params": {"heads": bundle["target"]}},
+                out["action_hidden"],
+                method=CausalLMWithILQLHeads.target_qs,
+            )
+            minq = target_qs[0]
+            for tq in target_qs[1:]:
+                minq = jnp.minimum(minq, tq)
+            adv = minq - out["vs"][..., None]
+            logits = jax.nn.log_softmax(out["logits"], axis=-1) + self.beta * adv
+            if logit_mask is not None:
+                allowed = logit_mask[input_ids]  # [B, T, V] bool
+                logits = jnp.where(allowed, logits, -1e9)
+            return {"logits": logits, "cache": out["cache"]}
+
+        sampler = make_sampler(
+            sample_apply,
+            functools.partial(init_cache, self.model_config),
+            self.gen_config,
+            self.query_length,
+            with_values=False,
+        )
+        bundle_shardings = {
+            "params": self.param_shardings,
+            "target": self.target_shardings,
+        }
+        self._sample_jit = jax.jit(
+            sampler,
+            in_shardings=(bundle_shardings, batch_sh, batch_sh, rep),
+            out_shardings=batch_sh,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def sample(self, prompt_ids, prompt_mask):
+        self.rng, key = jax.random.split(self.rng)
+        return self._sample_jit(
+            {"params": self.state.params, "target": self.state.target_q_params},
+            prompt_ids,
+            prompt_mask,
+            key,
+        )
+
+    @property
+    def eval_batch_size(self) -> int:
+        return self.config.train.batch_size
+
+    def learn(self) -> Dict[str, Any]:
+        """Offline optimization loop (reference `accelerate_base_model.py
+        :224-305` without experience refresh)."""
+        train = self.config.train
+        if self.store is None:
+            raise ValueError("no offline data: run OfflineOrchestrator.make_experience")
+
+        n_minibatches = max(len(self.store) // train.batch_size, 1)
+        total_steps = min(train.total_steps, train.epochs * n_minibatches)
+
+        logger = Logger(
+            project_name=train.project_name,
+            run_name=train.run_name,
+            config=self.config.to_dict(),
+            tags=train.tags,
+        )
+        self.logger = logger
+        stats = self.evaluate()
+        logger.log(stats, step=0)
+
+        clock = Clock()
+        iter_count = 0
+        final_stats: Dict[str, Any] = {}
+        for epoch in range(train.epochs):
+            for mb in self.store.create_loader(
+                train.batch_size,
+                shuffle=True,
+                seed=train.seed + epoch,
+                sharding=batch_sharding(self.mesh),
+            ):
+                self.state, step_stats = self._train_step_jit(self.state, mb)
+                iter_count += 1
+                step_stats["time/batch"] = clock.tick(train.batch_size) / 1000.0
+                iv = self.intervals(iter_count)
+                if iv["do_log"]:
+                    logger.log(step_stats, step=iter_count)
+                    final_stats = {k: float(v) for k, v in step_stats.items()}
+                if iv["do_eval"]:
+                    eval_stats = self.evaluate()
+                    logger.log(eval_stats, step=iter_count)
+                    final_stats.update(eval_stats)
+                if iv["do_save"]:
+                    self.save()
+                if iter_count >= total_steps:
+                    self.save()
+                    eval_stats = self.evaluate()
+                    logger.log(eval_stats, step=iter_count)
+                    final_stats.update(eval_stats)
+                    logger.finish()
+                    return final_stats
+        logger.finish()
+        return final_stats
+
+    def save(self, directory: Optional[str] = None) -> None:
+        save_checkpoint(
+            directory or self.config.train.checkpoint_dir, self.state, metadata={}
+        )
+
+    def load(self, directory: str) -> None:
+        abstract = jax.tree_util.tree_map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            self.state,
+            self.state_shardings,
+        )
+        self.state, _ = load_checkpoint(directory, abstract)

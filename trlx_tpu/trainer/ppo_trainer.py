@@ -304,81 +304,6 @@ class PPOTrainer(BaseRLTrainer):
         self.mean_kl = float(mean_kl)
         return rewards
 
-    def decode_responses(self, tokens, response_mask) -> List[str]:
-        """Detokenize responses, truncated at their mask (host boundary)."""
-        tokens = np.asarray(tokens)
-        lengths = np.asarray(response_mask).sum(axis=1)
-        out = []
-        for row, n in zip(tokens, lengths):
-            ids = row[: int(n)].tolist()
-            if self.tokenizer is not None:
-                out.append(self.tokenizer.decode(ids, skip_special_tokens=True))
-            else:
-                out.append(" ".join(map(str, ids)))
-        return out
-
-    def decode_queries(self, q_ids, q_mask) -> List[str]:
-        q_ids, q_mask = np.asarray(q_ids), np.asarray(q_mask)
-        out = []
-        for row, m in zip(q_ids, q_mask):
-            ids = row[m.astype(bool)].tolist()
-            if self.tokenizer is not None:
-                out.append(self.tokenizer.decode(ids, skip_special_tokens=True))
-            else:
-                out.append(" ".join(map(str, ids)))
-        return out
-
-    # ------------------------------------------------------------------ #
-
-    def evaluate(self) -> Dict[str, Any]:
-        """Sample eval prompts, score, and build a sample table (reference
-        `accelerate_base_model.py:152-222`)."""
-        if self.eval_pipeline is None:
-            return {}
-        clock = Clock()
-        all_queries, all_texts, all_gt = [], [], []
-        # always full chunk-size batches (pad-filled) so the compiled sampler
-        # is reused and batch dims divide the mesh's data shards
-        for batch, meta in self.eval_pipeline.create_loader(
-            self.config.method.chunk_size, shuffle=False, drop_last=False
-        ):
-            out = self.sample(batch.input_ids, batch.attention_mask)
-            n_real = meta["n_real"]
-            texts = self.decode_responses(out.tokens, out.response_mask)[:n_real]
-            if meta["prompts_text"][0] is not None:
-                queries = meta["prompts_text"][:n_real]
-            else:
-                queries = self.decode_queries(batch.input_ids, batch.attention_mask)[:n_real]
-            all_queries += queries
-            all_texts += texts
-            if meta["response_gt"] is not None:
-                all_gt += meta["response_gt"][:n_real]
-        generate_time = clock.tick() / 1000.0
-
-        stats: Dict[str, Any] = {"time/generate": generate_time}
-        columns = ["query", "response"]
-        table = [list(t) for t in zip(all_queries, all_texts)]
-        if self.reward_fn is not None:
-            scores = np.asarray(
-                self.reward_fn(
-                    samples=all_texts,
-                    queries=all_queries,
-                    response_gt=all_gt if all_gt else None,
-                ),
-                dtype=np.float32,
-            )
-            stats["reward/mean"] = float(scores.mean())
-            stats["reward/std"] = float(scores.std())
-            columns.append("reward")
-            table = [row + [float(s)] for row, s in zip(table, scores)]
-        if self.metric_fn is not None:
-            metrics = self.metric_fn(all_texts)
-            for k, v in metrics.items():
-                v = np.asarray(v, dtype=np.float32)
-                stats[f"metrics/{k}"] = float(v.mean())
-        self._last_samples = (columns, table)
-        return stats
-
     def learn(self) -> Dict[str, Any]:
         """PPO optimization loop (reference `accelerate_base_model.py:224-305`
         + `accelerate_ppo_model.py:130-156`): per-epoch buffer pass with
