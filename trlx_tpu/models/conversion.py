@@ -102,6 +102,125 @@ def convert_gpt2_state_dict(
     return params
 
 
+def t5_config_from_hf(path_or_dict) -> "T5Config":
+    """Read an HF T5/UL2 ``config.json`` into :class:`T5Config`."""
+    from trlx_tpu.models.t5 import T5Config
+
+    if isinstance(path_or_dict, (str, os.PathLike)):
+        with open(os.path.join(path_or_dict, "config.json")) as f:
+            d = json.load(f)
+    elif hasattr(path_or_dict, "to_dict"):
+        d = path_or_dict.to_dict()
+    else:
+        d = dict(path_or_dict)
+    return T5Config(
+        vocab_size=d["vocab_size"],
+        d_model=d["d_model"],
+        d_kv=d["d_kv"],
+        d_ff=d["d_ff"],
+        num_layers=d["num_layers"],
+        num_decoder_layers=d.get("num_decoder_layers", d["num_layers"]),
+        num_heads=d["num_heads"],
+        relative_attention_num_buckets=d.get("relative_attention_num_buckets", 32),
+        relative_attention_max_distance=d.get("relative_attention_max_distance", 128),
+        layer_norm_epsilon=d.get("layer_norm_epsilon", 1e-6),
+        feed_forward_proj=d.get("feed_forward_proj", "relu"),
+        tie_word_embeddings=d.get("tie_word_embeddings", True),
+        decoder_start_token_id=d.get("decoder_start_token_id", 0) or 0,
+    )
+
+
+def convert_t5_state_dict(
+    state_dict: Mapping[str, Any], config, dtype: str = "float32"
+) -> Dict[str, Any]:
+    """HF ``T5ForConditionalGeneration`` state dict -> ``T5Model`` param tree.
+
+    torch ``nn.Linear`` stores (out, in); flax Dense wants (in, out) — every
+    projection kernel transposes. HF parameterizes the relative attention
+    bias inside block 0 of each stack and reuses it downstream; here it maps
+    to the stack-level ``enc_rel_bias``/``dec_rel_bias`` modules.
+    """
+    sd = dict(state_dict)
+    cast = lambda t: jnp.asarray(_np(t), dtype=jnp.dtype(dtype))
+    castT = lambda t: jnp.asarray(_np(t).T.copy(), dtype=jnp.dtype(dtype))
+
+    def attn(prefix: str) -> Dict[str, Any]:
+        return {
+            "q": {"kernel": castT(sd[prefix + ".q.weight"])},
+            "k": {"kernel": castT(sd[prefix + ".k.weight"])},
+            "v": {"kernel": castT(sd[prefix + ".v.weight"])},
+            "o": {"kernel": castT(sd[prefix + ".o.weight"])},
+        }
+
+    def ff(prefix: str) -> Dict[str, Any]:
+        if config.is_gated_act:
+            return {
+                "wi_0": {"kernel": castT(sd[prefix + ".wi_0.weight"])},
+                "wi_1": {"kernel": castT(sd[prefix + ".wi_1.weight"])},
+                "wo": {"kernel": castT(sd[prefix + ".wo.weight"])},
+            }
+        return {
+            "wi": {"kernel": castT(sd[prefix + ".wi.weight"])},
+            "wo": {"kernel": castT(sd[prefix + ".wo.weight"])},
+        }
+
+    params: Dict[str, Any] = {
+        "shared": {"embedding": cast(sd["shared.weight"])},
+        "enc_rel_bias": {
+            "relative_attention_bias": {
+                "embedding": cast(
+                    sd["encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
+                )
+            }
+        },
+        "dec_rel_bias": {
+            "relative_attention_bias": {
+                "embedding": cast(
+                    sd["decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
+                )
+            }
+        },
+        "enc_final_ln": {"weight": cast(sd["encoder.final_layer_norm.weight"])},
+        "dec_final_ln": {"weight": cast(sd["decoder.final_layer_norm.weight"])},
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"kernel": castT(sd["lm_head.weight"])}
+
+    for i in range(config.num_layers):
+        p = f"encoder.block.{i}."
+        params[f"enc_{i}"] = {
+            "SelfAttention": attn(p + "layer.0.SelfAttention"),
+            "ln_self": {"weight": cast(sd[p + "layer.0.layer_norm.weight"])},
+            "DenseReluDense": ff(p + "layer.1.DenseReluDense"),
+            "ln_ff": {"weight": cast(sd[p + "layer.1.layer_norm.weight"])},
+        }
+    for i in range(config.num_decoder_layers):
+        p = f"decoder.block.{i}."
+        params[f"dec_{i}"] = {
+            "SelfAttention": attn(p + "layer.0.SelfAttention"),
+            "ln_self": {"weight": cast(sd[p + "layer.0.layer_norm.weight"])},
+            "EncDecAttention": attn(p + "layer.1.EncDecAttention"),
+            "ln_cross": {"weight": cast(sd[p + "layer.1.layer_norm.weight"])},
+            "DenseReluDense": ff(p + "layer.2.DenseReluDense"),
+            "ln_ff": {"weight": cast(sd[p + "layer.2.layer_norm.weight"])},
+        }
+    return params
+
+
+def load_t5_checkpoint(model_path: str, dtype: str = "float32"):
+    """Load an on-disk HF T5/UL2 checkpoint -> (T5Config, param tree).
+
+    The fork loads its checkpoint in bf16 (`ppo_models.py:610-615`); here
+    param dtype is configurable (bf16 compute is set by the arch config).
+    """
+    from transformers import AutoModelForSeq2SeqLM
+
+    model = AutoModelForSeq2SeqLM.from_pretrained(model_path, local_files_only=True)
+    config = t5_config_from_hf(model.config)
+    params = convert_t5_state_dict(model.state_dict(), config, dtype)
+    return config, params
+
+
 def load_gpt2_checkpoint(model_path: str, dtype: str = "float32"):
     """Load an on-disk HF GPT-2 checkpoint -> (GPT2Config, param tree).
 

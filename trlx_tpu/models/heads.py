@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model
+from trlx_tpu.models.t5 import T5Config, T5Model
 
 
 class MLPHead(nn.Module):
@@ -96,6 +97,72 @@ class CausalLMWithValueHead(nn.Module):
             cache=cache,
             cache_index=cache_index,
         )
+
+
+class T5WithValueHead(nn.Module):
+    """T5/UL2 + scalar value head on decoder hidden states — the fork's
+    policy model (``T5HeadWithValueModel``, `ppo_models.py:607-655`; value
+    head on ``d_model``, applied to decoder hidden states :638-641, but
+    without the reference's fragile ``decoder_hidden_states`` tuple-vs-tensor
+    assumption).
+
+    Methods mirror the backbone's: full teacher-forced ``__call__`` plus
+    ``encode`` / ``decode`` / ``init_cross_kv`` for compiled sampling.
+    """
+
+    config: T5Config
+
+    def setup(self):
+        self.backbone = T5Model(self.config, name="t5")
+        self.v_head = MLPHead(
+            self.config.d_model,
+            1,
+            dtype=self.config.dtype,
+            param_dtype=self.config.param_dtype,
+            name="v_head",
+        )
+
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        decoder_input_ids=None,
+        decoder_attention_mask=None,
+    ):
+        out = self.backbone(
+            input_ids,
+            attention_mask=attention_mask,
+            decoder_input_ids=decoder_input_ids,
+            decoder_attention_mask=decoder_attention_mask,
+        )
+        out["values"] = self.v_head(out["hidden"])[..., 0]
+        return out
+
+    def encode(self, input_ids, attention_mask=None):
+        return self.backbone.encode(input_ids, attention_mask)
+
+    def init_cross_kv(self, encoder_hidden):
+        return self.backbone.init_cross_kv(encoder_hidden)
+
+    def decode(
+        self,
+        decoder_input_ids,
+        encoder_mask=None,
+        decoder_mask=None,
+        cache=None,
+        cache_index=None,
+        cross_kv=None,
+    ):
+        out = self.backbone.decode(
+            decoder_input_ids,
+            encoder_mask=encoder_mask,
+            decoder_mask=decoder_mask,
+            cache=cache,
+            cache_index=cache_index,
+            cross_kv=cross_kv,
+        )
+        out["values"] = self.v_head(out["hidden"])[..., 0]
+        return out
 
 
 class ILQLHeads(nn.Module):

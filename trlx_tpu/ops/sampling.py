@@ -46,6 +46,7 @@ class GenerationConfig:
     # seq2seq/forced-BOS support (the fork forces a Chinese BOS token,
     # `ppo_models.py:620-622`); -1 = disabled
     forced_bos_token_id: int = -1
+    decoder_start_token_id: int = 0
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "GenerationConfig":
@@ -174,6 +175,116 @@ def make_sampler(
 
         finished0 = jnp.zeros((B,), bool)
         (_, _, _, _, _), (tokens, mask, logprobs, values) = jax.lax.scan(
+            step,
+            (cache, logits_last, value_last, finished0, rng),
+            jnp.arange(R),
+        )
+        return SampleOutput(
+            tokens=tokens.T,
+            response_mask=mask.T,
+            logprobs=logprobs.T,
+            values=values.T,
+        )
+
+    return sampler
+
+
+def make_seq2seq_sampler(
+    encode_fn: Callable,
+    decode_fn: Callable,
+    init_cross_kv_fn: Callable,
+    init_cache_fn: Callable,
+    gen_config: GenerationConfig,
+    with_values: bool = True,
+):
+    """Compiled encoder-decoder sampling (the fork's T5 ``generate`` path,
+    `ppo_models.py:620-622`, as one XLA program).
+
+    Encoder runs once; cross-attention K/V are precomputed per layer; the
+    decoder scan feeds one token per step into a fixed-capacity self-attn
+    cache. The decoder-start token occupies cache slot 0 (stripped from the
+    response, as the reference strips it at `ppo_orchestrator.py:80`);
+    ``forced_bos_token_id`` (the fork's Chinese BOS) is emitted at step 0
+    when configured.
+
+    - ``encode_fn(params, input_ids, attention_mask) -> encoder_hidden``
+    - ``init_cross_kv_fn(params, encoder_hidden) -> cross_kv``
+    - ``decode_fn(params, decoder_input_ids, encoder_mask, decoder_mask,
+      cache, cache_index, cross_kv) -> {"logits", "values"?, "cache"}``
+    - ``init_cache_fn(batch, capacity) -> decoder KV buffers``
+    """
+    R = gen_config.max_new_tokens
+    cap = R + 1  # slot 0 = decoder start token
+
+    def sampler(params, prompt_ids, prompt_mask, rng) -> SampleOutput:
+        B = prompt_ids.shape[0]
+        encoder_hidden = encode_fn(params, prompt_ids, prompt_mask)
+        cross_kv = init_cross_kv_fn(params, encoder_hidden)
+        cache = init_cache_fn(B, cap)
+        slot_ids = jnp.arange(cap)[None, :]
+
+        start = jnp.full((B, 1), gen_config.decoder_start_token_id, jnp.int32)
+        out = decode_fn(
+            params,
+            start,
+            encoder_mask=prompt_mask,
+            decoder_mask=(slot_ids <= 0).astype(jnp.int32).repeat(B, 0),
+            cache=cache,
+            cache_index=0,
+            cross_kv=cross_kv,
+        )
+        cache = out["cache"]
+        logits_last = out["logits"][:, -1].astype(jnp.float32)
+        value_last = (
+            out["values"][:, -1].astype(jnp.float32)
+            if with_values
+            else jnp.zeros((B,), jnp.float32)
+        )
+
+        def step(carry, t):
+            cache, logits_last, value_last, finished, rng = carry
+            rng, key = jax.random.split(rng)
+
+            raw_logprobs = jax.nn.log_softmax(logits_last, axis=-1)
+            if gen_config.do_sample:
+                filtered = filter_logits(logits_last, gen_config)
+                token = jax.random.categorical(key, filtered, axis=-1)
+            else:
+                token = jnp.argmax(logits_last, axis=-1)
+            token = token.astype(jnp.int32)
+            if gen_config.forced_bos_token_id >= 0:
+                token = jnp.where(
+                    t == 0,
+                    jnp.full((B,), gen_config.forced_bos_token_id, jnp.int32),
+                    token,
+                )
+            token = jnp.where(finished, gen_config.pad_token_id, token)
+
+            logprob = jnp.take_along_axis(raw_logprobs, token[:, None], axis=-1)[:, 0]
+            live = jnp.logical_not(finished)
+            finished = jnp.logical_or(finished, token == gen_config.eos_token_id)
+            ys = (token, live.astype(jnp.int32), logprob, value_last)
+
+            dec_mask = (slot_ids <= t + 1).astype(jnp.int32).repeat(B, 0)
+            out = decode_fn(
+                params,
+                token[:, None],
+                encoder_mask=prompt_mask,
+                decoder_mask=dec_mask,
+                cache=cache,
+                cache_index=t + 1,
+                cross_kv=cross_kv,
+            )
+            new_logits = out["logits"][:, 0].astype(jnp.float32)
+            new_value = (
+                out["values"][:, 0].astype(jnp.float32)
+                if with_values
+                else jnp.zeros((B,), jnp.float32)
+            )
+            return (out["cache"], new_logits, new_value, finished, rng), ys
+
+        finished0 = jnp.zeros((B,), bool)
+        _, (tokens, mask, logprobs, values) = jax.lax.scan(
             step,
             (cache, logits_last, value_last, finished0, rng),
             jnp.arange(R),
