@@ -1,0 +1,131 @@
+"""UL2/T5 seq2seq dialogue PPO — the fork's entry point, re-designed.
+
+Same capability as ``ul2_RL/rl_ul2.py``: prompt / ground-truth-response
+pairs feed a ``(samples, queries, response_gt)`` reward that mixes
+n-gram overlap with the ground truth (the reference's jieba-BLEU + Chinese
+ROUGE, `rl_ul2.py:10-44`, implemented here as dependency-free char n-gram
+F-scores) and a character-diversity score (`compute_simple_score`,
+`rl_ul2.py:46-50`), with sentinel truncation post-processing
+(`rl_ul2.py:52-68`). Pairs come from a TSV path argument — the reference
+hard-codes this path inside ``trlx.train`` (`trlx/trlx.py:46-54`); here it
+is an explicit argument.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import sys
+from collections import Counter
+from typing import List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.data.configs import TRLConfig
+
+SENTINELS = ("</s>", "<extra_id_1>", "<pad>")
+
+
+def truncate_response(text: str) -> str:
+    """Cut at the first sentinel and strip spaces (Chinese post-processing,
+    `rl_ul2.py:52-68`, `accelerate_base_model.py:182-183`)."""
+    for sentinel in SENTINELS:
+        idx = text.find(sentinel)
+        if idx >= 0:
+            text = text[:idx]
+    return text.replace(" ", "")
+
+
+def char_ngram_f(candidate: str, reference: str, n: int) -> float:
+    """Char n-gram F1 — dependency-free stand-in for jieba-BLEU/ROUGE."""
+    if len(candidate) < n or len(reference) < n:
+        return 0.0
+    c = Counter(candidate[i : i + n] for i in range(len(candidate) - n + 1))
+    r = Counter(reference[i : i + n] for i in range(len(reference) - n + 1))
+    overlap = sum((c & r).values())
+    if overlap == 0:
+        return 0.0
+    p = overlap / sum(c.values())
+    rec = overlap / sum(r.values())
+    return 2 * p * rec / (p + rec)
+
+
+def compute_simple_score(text: str) -> float:
+    """Char-diversity score (`rl_ul2.py:46-50`)."""
+    if not text:
+        return 0.0
+    return len(set(text)) / len(text)
+
+
+def make_reward_fn(overlap_weight: float = 0.7, diversity_weight: float = 0.3):
+    def reward_fn(samples: List[str], queries: List[str], response_gt=None):
+        scores = []
+        gts = response_gt or [""] * len(samples)
+        for sample, gt in zip(samples, gts):
+            text = truncate_response(sample)
+            overlap = 0.0
+            if gt:
+                overlap = 0.5 * char_ngram_f(text, gt, 1) + 0.5 * char_ngram_f(
+                    text, gt, 2
+                )
+            scores.append(
+                overlap_weight * overlap + diversity_weight * compute_simple_score(text)
+            )
+        return scores
+
+    return reward_fn
+
+
+def load_pairs(tsv_path: str) -> Tuple[List[str], List[str]]:
+    """prompt<TAB>response pairs (the fork's samples.tsv format)."""
+    prompts, gts = [], []
+    with open(tsv_path, newline="") as f:
+        for row in csv.reader(f, delimiter="\t"):
+            if len(row) >= 2:
+                prompts.append(row[0])
+                gts.append(row[1])
+    return prompts, gts
+
+
+def main(samples_tsv: Optional[str] = None, model_path: Optional[str] = None):
+    import numpy as np
+
+    import trlx_tpu
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = TRLConfig.load_yaml(os.path.join(repo, "configs", "ppo_ul2.yml"))
+    if model_path:
+        config.model.model_path = model_path
+        config.model.tokenizer_path = model_path
+
+    if samples_tsv:
+        prompts, gts = load_pairs(samples_tsv)
+        tokenizer = None  # built by the trainer from tokenizer_path
+    else:
+        # zero-egress fallback: synthetic token-id pairs on the UL2 vocab
+        rng = np.random.default_rng(0)
+        prompts = [
+            list(rng.integers(100, 21000, size=rng.integers(8, 64)))
+            for _ in range(256)
+        ]
+        gts = ["".join(chr(0x4E00 + int(c)) for c in rng.integers(0, 500, 12))
+               for _ in range(256)]
+        tokenizer = None
+
+    trlx_tpu.train(
+        reward_fn=make_reward_fn(),
+        prompts=prompts,
+        response_gt=gts,
+        config=config,
+        tokenizer=tokenizer,
+    )
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--samples-tsv", default=None, help="prompt\\tresponse pairs")
+    p.add_argument("--model-path", default=None, help="HF UL2/T5 checkpoint dir")
+    a = p.parse_args()
+    main(a.samples_tsv, a.model_path)

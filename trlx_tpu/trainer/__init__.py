@@ -42,12 +42,9 @@ def register_trainer(name=None):
 def get_trainer(name: str) -> type:
     key = name.lower()
     if key not in _TRAINERS:
+        import trlx_tpu.trainer.ilql_trainer  # noqa: F401
         import trlx_tpu.trainer.ppo_trainer  # noqa: F401
-
-        try:
-            import trlx_tpu.trainer.ilql_trainer  # noqa: F401
-        except ImportError:
-            pass
+        import trlx_tpu.trainer.seq2seq_ppo_trainer  # noqa: F401
     if key in _TRAINERS:
         return _TRAINERS[key]
     raise ValueError(f"Unknown trainer: {name!r}. Registered: {sorted(_TRAINERS)}")

@@ -9,9 +9,9 @@ Re-design of ``AcceleratePPOModel`` (``trlx/model/accelerate_ppo_model.py``)
   (backbone-only) param pytree — the fork's full-frozen-copy path
   (`ppo_orchestrator.py:41-43`) with no second process-visible module.
 - ``loss()`` (`accelerate_ppo_model.py:79-128`) becomes one jitted
-  ``train_step``: full-seq forward, response-slice logprobs/values, GAE
-  (reversed ``lax.scan``), clipped surrogate, grads, optax update — gradient
-  sync is the psum GSPMD inserts for the sharded batch; there is no
+  ``train_step``: policy forward, response logprobs/values, GAE (reversed
+  ``lax.scan``), clipped surrogate, grads, optax update — gradient sync is
+  the psum GSPMD inserts for the sharded batch; there is no
   ``accelerator.backward``.
 - Generation is the compiled sampler from ``ops/sampling.py``; behavior
   logprobs and values are emitted during decode, so the orchestrator's
@@ -19,12 +19,15 @@ Re-design of ``AcceleratePPOModel`` (``trlx/model/accelerate_ppo_model.py``)
 - The KL coefficient is host loop state updated per batch via the adaptive
   controller (`accelerate_ppo_model.py:136-137`), passed into the reward
   computation as a device scalar (no retrace).
+
+Model-family specifics (forward slicing, sampler construction, checkpoint
+conversion) are isolated in overridable hooks; the seq2seq (T5/UL2) variant
+lives in ``seq2seq_ppo_trainer.py``.
 """
 
 from __future__ import annotations
 
 import functools
-import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -85,6 +88,9 @@ def get_gpt2_arch(config: TRLConfig):
 
 @register_trainer
 class PPOTrainer(BaseRLTrainer):
+    # param-tree key holding the (KL-reference) backbone
+    backbone_key = "transformer"
+
     def __init__(
         self,
         config: TRLConfig,
@@ -109,9 +115,7 @@ class PPOTrainer(BaseRLTrainer):
             if self.tokenizer.pad_token_id is None:
                 self.tokenizer.pad_token = self.tokenizer.eos_token
 
-        self.model_config, init_params = get_gpt2_arch(config)
-        self.model = CausalLMWithValueHead(self.model_config)
-        self.backbone = GPT2Model(self.model_config)
+        init_params = self._setup_model()
 
         gen_kwargs = dict(method.gen_kwargs)
         if self.tokenizer is not None:
@@ -120,29 +124,29 @@ class PPOTrainer(BaseRLTrainer):
                 "pad_token_id",
                 self.tokenizer.pad_token_id or self.tokenizer.eos_token_id,
             )
+        self._amend_gen_kwargs(gen_kwargs)
         self.gen_config = GenerationConfig.from_dict(gen_kwargs)
         self.query_length = train.seq_length
 
         # --- params, shardings, optimizer, state ---
         self.rng, init_rng = jax.random.split(self.rng)
-        dummy = jnp.zeros((1, 8), jnp.int32)
-        params = self.model.init(init_rng, dummy)["params"]
+        params = self._init_params(init_rng)
         if init_params is not None:
-            params["transformer"] = init_params
+            params[self.backbone_key] = init_params
 
         self.param_shardings = self._shardings_for(params)
         params = jax.device_put(params, self.param_shardings)
         # frozen KL reference = deep copy of the initial policy backbone
         # (fork's full-copy path, `ppo_orchestrator.py:41-43`). jnp.copy
         # forces fresh buffers — the policy's are donated every train step.
-        self.ref_shardings = self._shardings_for(params["transformer"])
+        self.ref_shardings = self._shardings_for(params[self.backbone_key])
         self.ref_params = jax.device_put(
-            jax.tree_util.tree_map(jnp.copy, params["transformer"]),
+            jax.tree_util.tree_map(jnp.copy, params[self.backbone_key]),
             self.ref_shardings,
         )
 
         trainable = unfrozen_param_mask(
-            params, config.model.num_layers_unfrozen, self.model_config.n_layer
+            params, config.model.num_layers_unfrozen, self._n_layers()
         )
         self.tx = make_optimizer(train, train.total_steps, trainable)
         opt_shapes = jax.eval_shape(self.tx.init, params)
@@ -161,26 +165,32 @@ class PPOTrainer(BaseRLTrainer):
         self.buffer = PPORolloutBuffer()
         self.kl_coef = float(method.init_kl_coef)
         self.mean_kl = 0.0
-        self.approx_reward_mean = 0.0
 
         self._build_jitted_fns()
 
-    # ------------------------------------------------------------------ #
+    # ----------------------- model-family hooks ----------------------- #
 
-    def _shardings_for(self, tree):
-        specs = make_partition_specs(tree, self.mesh, PARTITION_RULES)
-        return jax.tree_util.tree_map(
-            lambda s: NamedSharding(self.mesh, s),
-            specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
+    def _setup_model(self):
+        """Build arch config + flax modules; return converted checkpoint
+        params (or None)."""
+        self.model_config, init_params = get_gpt2_arch(self.config)
+        self.model = CausalLMWithValueHead(self.model_config)
+        self.backbone = GPT2Model(self.model_config)
+        self.partition_rules = PARTITION_RULES
+        return init_params
 
-    def _build_jitted_fns(self):
-        mesh = self.mesh
-        Q = self.query_length
-        method: PPOConfig = self.config.method
-        batch_sh = batch_sharding(mesh)
-        rep = replicated(mesh)
+    def _amend_gen_kwargs(self, gen_kwargs: Dict) -> None:
+        pass
+
+    def _n_layers(self) -> int:
+        return self.model_config.n_layer
+
+    def _init_params(self, rng):
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        return self.model.init(rng, dummy)["params"]
+
+    def _make_sampler(self) -> Callable:
+        """Jittable (params, prompt_ids, prompt_mask, rng) -> SampleOutput."""
 
         def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
                      cache=None, cache_index=None):
@@ -193,30 +203,62 @@ class PPOTrainer(BaseRLTrainer):
                 cache_index=cache_index,
             )
 
-        sampler = make_sampler(
+        return make_sampler(
             apply_fn,
             functools.partial(init_cache, self.model_config),
             self.gen_config,
-            Q,
+            self.query_length,
             with_values=True,
         )
+
+    def _forward_logprobs_values(self, params, mb: PPORolloutBatch):
+        """Policy forward -> (logprobs, values) over response positions.
+
+        Causal LM: forward [query; response], slice positions Q-1..Q+R-2
+        (the states that *predict* each response token)."""
+        Q = self.query_length
+        full_ids = jnp.concatenate([mb.query_tokens, mb.response_tokens], axis=1)
+        full_mask = jnp.concatenate([mb.query_mask, mb.response_mask], axis=1)
+        out = self.model.apply({"params": params}, full_ids, attention_mask=full_mask)
+        logits = out["logits"][:, Q - 1 : -1]
+        values = out["values"][:, Q - 1 : -1].astype(jnp.float32)
+        logprobs = logprobs_from_logits(logits, mb.response_tokens)
+        return logprobs, values
+
+    def _ref_logprobs(self, ref_params, q_ids, q_mask, r_ids, r_mask):
+        """Frozen-reference logprobs of the sampled responses."""
+        Q = self.query_length
+        full_ids = jnp.concatenate([q_ids, r_ids], axis=1)
+        full_mask = jnp.concatenate([q_mask, r_mask], axis=1)
+        out = self.backbone.apply(
+            {"params": ref_params}, full_ids, attention_mask=full_mask
+        )
+        logits = out["logits"][:, Q - 1 : -1]
+        return logprobs_from_logits(logits, r_ids)
+
+    # ------------------------------------------------------------------ #
+
+    def _shardings_for(self, tree):
+        specs = make_partition_specs(tree, self.mesh, self.partition_rules)
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def _build_jitted_fns(self):
+        method: PPOConfig = self.config.method
+        batch_sh = batch_sharding(self.mesh)
+        rep = replicated(self.mesh)
+
         self._sample_jit = jax.jit(
-            sampler,
+            self._make_sampler(),
             in_shardings=(self.param_shardings, batch_sh, batch_sh, rep),
             out_shardings=batch_sh,
         )
 
-        def score_ref(ref_params, q_ids, q_mask, r_ids, r_mask):
-            full_ids = jnp.concatenate([q_ids, r_ids], axis=1)
-            full_mask = jnp.concatenate([q_mask, r_mask], axis=1)
-            out = self.backbone.apply(
-                {"params": ref_params}, full_ids, attention_mask=full_mask
-            )
-            logits = out["logits"][:, Q - 1 : -1]
-            return logprobs_from_logits(logits, r_ids)
-
         self._score_ref_jit = jax.jit(
-            score_ref,
+            self._ref_logprobs,
             in_shardings=(self.ref_shardings, batch_sh, batch_sh, batch_sh, batch_sh),
             out_shardings=batch_sh,
         )
@@ -238,18 +280,11 @@ class PPOTrainer(BaseRLTrainer):
 
         def train_step(state: TrainState, mb: PPORolloutBatch):
             def loss_fn(params):
-                full_ids = jnp.concatenate([mb.query_tokens, mb.response_tokens], axis=1)
-                full_mask = jnp.concatenate([mb.query_mask, mb.response_mask], axis=1)
-                out = self.model.apply(
-                    {"params": params}, full_ids, attention_mask=full_mask
-                )
-                logits = out["logits"][:, Q - 1 : -1]
-                values = out["values"][:, Q - 1 : -1].astype(jnp.float32)
-                logprobs = logprobs_from_logits(logits, mb.response_tokens)
+                logprobs, values = self._forward_logprobs_values(params, mb)
                 advantages, returns = get_advantages_and_returns(
                     mb.values, mb.rewards, mb.response_mask, method.gamma, method.lam
                 )
-                loss, stats = ppo_loss(
+                return ppo_loss(
                     logprobs,
                     values,
                     mb.logprobs,
@@ -261,7 +296,6 @@ class PPOTrainer(BaseRLTrainer):
                     method.cliprange_value,
                     method.vf_coef,
                 )
-                return loss, stats
 
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params
@@ -359,9 +393,7 @@ class PPOTrainer(BaseRLTrainer):
                 iv = self.intervals(iter_count)
                 if iv["do_log"]:
                     logger.log(step_stats, step=iter_count)
-                    final_stats = {
-                        k: float(v) for k, v in step_stats.items()
-                    }
+                    final_stats = {k: float(v) for k, v in step_stats.items()}
                 if iv["do_eval"]:
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)

@@ -1,0 +1,134 @@
+"""Seq2seq (T5/UL2) PPO trainer — the fork's headline path.
+
+Re-design of the fork's T5 wiring inside ``AcceleratePPOModel``:
+``shift_tokens_right`` + ``get_model_inputs`` (`accelerate_ppo_model.py
+:18-25,63-76`), the T5 generate kwargs with decoder-start / forced Chinese
+BOS (`accelerate_ppo_model.py:50-54`, `ppo_models.py:620-622`), and the
+T5 value-head forward (`ppo_models.py:624-655`).
+
+The rollout layout maps cleanly onto the shared PPO machinery: the "query"
+is the encoder input, the "response" the decoder output; logprobs/values
+align position-for-position with the teacher-forced forward on
+``shift_right(response)`` (verified in ``tests/test_t5_parity.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.ppo_types import PPORolloutBatch
+from trlx_tpu.models.heads import T5WithValueHead
+from trlx_tpu.models.t5 import (
+    T5Config,
+    T5Model,
+    T5_PARTITION_RULES,
+    init_t5_cache,
+    shift_tokens_right,
+)
+from trlx_tpu.ops.sampling import make_seq2seq_sampler
+from trlx_tpu.parallel import logprobs_from_logits
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+
+def get_t5_arch(config: TRLConfig):
+    model_cfg = config.model
+    overrides = dict(model_cfg.model_arch)
+    overrides.setdefault("dtype", config.train.dtype)
+    overrides.setdefault("param_dtype", config.train.param_dtype)
+    if model_cfg.model_path:
+        from trlx_tpu.models.conversion import load_t5_checkpoint
+
+        arch, params = load_t5_checkpoint(
+            model_cfg.model_path, dtype=config.train.param_dtype
+        )
+        arch = T5Config(
+            **{
+                **arch.__dict__,
+                "dtype": overrides["dtype"],
+                "param_dtype": overrides["param_dtype"],
+            }
+        )
+        return arch, params
+    return T5Config.from_dict(overrides), None
+
+
+@register_trainer("Seq2SeqPPOTrainer")
+@register_trainer("T5PPOTrainer")
+class Seq2SeqPPOTrainer(PPOTrainer):
+    backbone_key = "t5"
+
+    def _setup_model(self):
+        self.model_config, init_params = get_t5_arch(self.config)
+        self.model = T5WithValueHead(self.model_config)
+        self.backbone = T5Model(self.model_config)
+        self.partition_rules = T5_PARTITION_RULES
+        return init_params
+
+    def _amend_gen_kwargs(self, gen_kwargs: Dict) -> None:
+        gen_kwargs.setdefault(
+            "decoder_start_token_id", self.model_config.decoder_start_token_id
+        )
+
+    def _n_layers(self) -> int:
+        return self.model_config.num_decoder_layers
+
+    def _init_params(self, rng):
+        return self.model.init(
+            rng,
+            jnp.zeros((1, 8), jnp.int32),
+            decoder_input_ids=jnp.zeros((1, 2), jnp.int32),
+        )["params"]
+
+    def _make_sampler(self):
+        model = self.model
+        return make_seq2seq_sampler(
+            lambda p, ids, mask: model.apply(
+                {"params": p}, ids, mask, method=T5WithValueHead.encode
+            ),
+            lambda p, ids, **kw: model.apply(
+                {"params": p}, ids, method=T5WithValueHead.decode, **kw
+            ),
+            lambda p, enc: model.apply(
+                {"params": p}, enc, method=T5WithValueHead.init_cross_kv
+            ),
+            functools.partial(init_t5_cache, self.model_config),
+            self.gen_config,
+            with_values=True,
+        )
+
+    def _decoder_inputs(self, mb_response_tokens, mb_response_mask):
+        pad = self.gen_config.pad_token_id
+        start = self.gen_config.decoder_start_token_id
+        dec_ids = shift_tokens_right(mb_response_tokens, pad, start)
+        dec_mask = jnp.concatenate(
+            [jnp.ones_like(mb_response_mask[:, :1]), mb_response_mask[:, :-1]], axis=1
+        )
+        return dec_ids, dec_mask
+
+    def _forward_logprobs_values(self, params, mb: PPORolloutBatch):
+        dec_ids, dec_mask = self._decoder_inputs(mb.response_tokens, mb.response_mask)
+        out = self.model.apply(
+            {"params": params},
+            mb.query_tokens,
+            attention_mask=mb.query_mask,
+            decoder_input_ids=dec_ids,
+            decoder_attention_mask=dec_mask,
+        )
+        logprobs = logprobs_from_logits(out["logits"], mb.response_tokens)
+        return logprobs, out["values"].astype(jnp.float32)
+
+    def _ref_logprobs(self, ref_params, q_ids, q_mask, r_ids, r_mask):
+        dec_ids, dec_mask = self._decoder_inputs(r_ids, r_mask)
+        out = self.backbone.apply(
+            {"params": ref_params},
+            q_ids,
+            attention_mask=q_mask,
+            decoder_input_ids=dec_ids,
+            decoder_attention_mask=dec_mask,
+        )
+        return logprobs_from_logits(out["logits"], r_ids)
