@@ -1,0 +1,129 @@
+"""Hydra frozen-branch tests (reference ``TestHydraHead``,
+``tests/test_ppo.py:10-47``): the frozen branch's reference logits must
+exactly equal the trunk's own logits at init, and frozen layers must not
+move under training."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def hydra_trainer():
+    import os
+
+    os.environ["WANDB_DISABLED"] = "1"
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "num_layers_unfrozen": 2,
+                "model_arch": {
+                    "vocab_size": 40,
+                    "n_positions": 32,
+                    "n_embd": 32,
+                    "n_layer": 4,
+                    "n_head": 2,
+                },
+            },
+            "train": {
+                "seq_length": 6,
+                "batch_size": 8,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "float32",
+            },
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 8,
+                "chunk_size": 8,
+                "ppo_epochs": 1,
+                "gen_kwargs": {
+                    "max_new_tokens": 4,
+                    "do_sample": True,
+                    "eos_token_id": 38,
+                    "pad_token_id": 39,
+                },
+            },
+        }
+    )
+    return get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+
+
+def test_hydra_ref_matches_policy_at_init(hydra_trainer):
+    """Frozen-branch logprobs == full-policy logprobs before any update
+    (branch params are copies of the policy's top blocks)."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.parallel.collectives import logprobs_from_logits
+
+    t = hydra_trainer
+    assert t.use_hydra and t.branch_start == 2
+    rng = np.random.default_rng(0)
+    B, Q, R = 8, 6, 4
+    q_ids = jnp.asarray(rng.integers(0, 38, size=(B, Q)), jnp.int32)
+    q_mask = jnp.ones((B, Q), jnp.int32)
+    r_ids = jnp.asarray(rng.integers(0, 38, size=(B, R)), jnp.int32)
+    r_mask = jnp.ones((B, R), jnp.int32)
+
+    ref_lp = np.asarray(t.score_ref(q_ids, q_mask, r_ids, r_mask))
+
+    full_ids = jnp.concatenate([q_ids, r_ids], axis=1)
+    full_mask = jnp.concatenate([q_mask, r_mask], axis=1)
+    out = t.backbone.apply(
+        {"params": t.state.params["transformer"]}, full_ids, attention_mask=full_mask
+    )
+    policy_lp = np.asarray(
+        logprobs_from_logits(out["logits"][:, Q - 1 : -1], r_ids)
+    )
+    np.testing.assert_allclose(ref_lp, policy_lp, atol=1e-5)
+
+
+def test_hydra_ref_memory_is_subset(hydra_trainer):
+    t = hydra_trainer
+    assert set(t.ref_params.keys()) == {"wte", "ln_f", "h_2", "h_3"}
+
+
+def test_frozen_layers_do_not_move(hydra_trainer):
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.data.ppo_types import PPORolloutBatch
+    from trlx_tpu.parallel.mesh import batch_sharding
+
+    t = hydra_trainer
+    rng = np.random.default_rng(1)
+    B, Q, R = 8, 6, 4
+    mb = PPORolloutBatch(
+        query_tokens=jnp.asarray(rng.integers(0, 38, size=(B, Q)), jnp.int32),
+        query_mask=jnp.ones((B, Q), jnp.int32),
+        response_tokens=jnp.asarray(rng.integers(0, 38, size=(B, R)), jnp.int32),
+        response_mask=jnp.ones((B, R), jnp.int32),
+        logprobs=jnp.asarray(rng.normal(size=(B, R)), jnp.float32),
+        values=jnp.asarray(rng.normal(size=(B, R)), jnp.float32),
+        rewards=jnp.asarray(rng.normal(size=(B, R)), jnp.float32),
+    )
+    mb = jax.device_put(mb, batch_sharding(t.mesh))
+
+    frozen_before = np.asarray(
+        t.state.params["transformer"]["h_0"]["attn"]["c_attn"]["kernel"]
+    ).copy()
+    wte_before = np.asarray(t.state.params["transformer"]["wte"]["embedding"]).copy()
+    unfrozen_before = np.asarray(
+        t.state.params["transformer"]["h_3"]["attn"]["c_attn"]["kernel"]
+    ).copy()
+
+    t.state, _ = t._train_step_jit(t.state, mb)
+
+    np.testing.assert_array_equal(
+        np.asarray(t.state.params["transformer"]["h_0"]["attn"]["c_attn"]["kernel"]),
+        frozen_before,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(t.state.params["transformer"]["wte"]["embedding"]), wte_before
+    )
+    assert not np.array_equal(
+        np.asarray(t.state.params["transformer"]["h_3"]["attn"]["c_attn"]["kernel"]),
+        unfrozen_before,
+    )

@@ -179,12 +179,15 @@ class GPT2Model(nn.Module):
         cache_index: Optional[jax.Array] = None,
         start_layer: int = 0,
         hidden_override: Optional[jax.Array] = None,
+        capture_hidden_at: Optional[int] = None,
     ):
-        """Returns ``{"logits", "hidden", "cache"}``.
+        """Returns ``{"logits", "hidden", "cache"[, "branch_hidden"]}``.
 
-        ``start_layer``/``hidden_override`` serve the hydra frozen branch:
-        re-run blocks ``start_layer..n_layer`` from a saved trunk activation
-        (`ppo_models.py:541-558`).
+        The hydra frozen-branch mechanism (`ppo_models.py:505-558`):
+        ``capture_hidden_at=k`` additionally returns the activation entering
+        block k; ``start_layer=k`` + ``hidden_override`` re-runs blocks
+        ``k..n_layer`` from that activation (with the frozen branch's own
+        params) to produce reference logits without a second trunk pass.
         """
         cfg = self.config
         T = input_ids.shape[1] if hidden_override is None else hidden_override.shape[1]
@@ -214,18 +217,24 @@ class GPT2Model(nn.Module):
         )
 
         new_cache: List = []
+        branch_hidden = None
         for i in range(start_layer, cfg.n_layer):
+            if capture_hidden_at is not None and i == capture_hidden_at:
+                branch_hidden = x
             layer_cache = cache[i] if cache is not None else None
             x, new_kv = self.h[i](x, bias, layer_cache, cache_index)
             new_cache.append(new_kv)
 
         x = self.ln_f(x)
         logits = self.logits(x)
-        return {
+        out = {
             "logits": logits,
             "hidden": x,
             "cache": tuple(new_cache) if cache is not None else None,
         }
+        if capture_hidden_at is not None:
+            out["branch_hidden"] = branch_hidden
+        return out
 
 
 def init_cache(config: GPT2Config, batch_size: int, capacity: int) -> Cache:

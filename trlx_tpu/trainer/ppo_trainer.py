@@ -136,14 +136,36 @@ class PPOTrainer(BaseRLTrainer):
 
         self.param_shardings = self._shardings_for(params)
         params = jax.device_put(params, self.param_shardings)
-        # frozen KL reference = deep copy of the initial policy backbone
-        # (fork's full-copy path, `ppo_orchestrator.py:41-43`). jnp.copy
-        # forces fresh buffers — the policy's are donated every train step.
-        self.ref_shardings = self._shardings_for(params[self.backbone_key])
-        self.ref_params = jax.device_put(
-            jax.tree_util.tree_map(jnp.copy, params[self.backbone_key]),
-            self.ref_shardings,
+
+        # Frozen KL reference. Two modes, as upstream (`ppo_models.py:505-558`
+        # vs `ppo_orchestrator.py:41-43`):
+        # - hydra (num_layers_unfrozen > 0): keep only the top-k blocks +
+        #   ln_f + embedding as the frozen branch; the (frozen) trunk is
+        #   shared with the policy — half the reference-model memory;
+        # - full copy otherwise (the fork's active path for T5).
+        # jnp.copy forces fresh buffers — the policy's are donated each step.
+        self.use_hydra = (
+            config.model.num_layers_unfrozen > 0 and self._supports_hydra()
         )
+        if self.use_hydra:
+            self.branch_start = self._n_layers() - config.model.num_layers_unfrozen
+            backbone = params[self.backbone_key]
+            ref_subset = {
+                k: v
+                for k, v in backbone.items()
+                if k in ("wte", "ln_f")
+                or (k.startswith("h_") and int(k.split("_")[1]) >= self.branch_start)
+            }
+            self.ref_shardings = self._shardings_for(ref_subset)
+            self.ref_params = jax.device_put(
+                jax.tree_util.tree_map(jnp.copy, ref_subset), self.ref_shardings
+            )
+        else:
+            self.ref_shardings = self._shardings_for(params[self.backbone_key])
+            self.ref_params = jax.device_put(
+                jax.tree_util.tree_map(jnp.copy, params[self.backbone_key]),
+                self.ref_shardings,
+            )
 
         trainable = unfrozen_param_mask(
             params, config.model.num_layers_unfrozen, self._n_layers()
@@ -225,14 +247,37 @@ class PPOTrainer(BaseRLTrainer):
         logprobs = logprobs_from_logits(logits, mb.response_tokens)
         return logprobs, values
 
-    def _ref_logprobs(self, ref_params, q_ids, q_mask, r_ids, r_mask):
-        """Frozen-reference logprobs of the sampled responses."""
+    def _supports_hydra(self) -> bool:
+        return True
+
+    def _ref_logprobs(self, ref_params, policy_params, q_ids, q_mask, r_ids, r_mask):
+        """Frozen-reference logprobs of the sampled responses.
+
+        Hydra mode re-runs only the frozen top blocks from the shared
+        trunk's activation (`ppo_models.py:541-558`); ``policy_params``
+        provide the trunk (identical to the branch's original trunk — those
+        layers are frozen)."""
         Q = self.query_length
         full_ids = jnp.concatenate([q_ids, r_ids], axis=1)
         full_mask = jnp.concatenate([q_mask, r_mask], axis=1)
-        out = self.backbone.apply(
-            {"params": ref_params}, full_ids, attention_mask=full_mask
-        )
+        if self.use_hydra:
+            trunk_out = self.backbone.apply(
+                {"params": policy_params[self.backbone_key]},
+                full_ids,
+                attention_mask=full_mask,
+                capture_hidden_at=self.branch_start,
+            )
+            out = self.backbone.apply(
+                {"params": ref_params},
+                full_ids,
+                attention_mask=full_mask,
+                start_layer=self.branch_start,
+                hidden_override=trunk_out["branch_hidden"],
+            )
+        else:
+            out = self.backbone.apply(
+                {"params": ref_params}, full_ids, attention_mask=full_mask
+            )
         logits = out["logits"][:, Q - 1 : -1]
         return logprobs_from_logits(logits, r_ids)
 
@@ -259,7 +304,14 @@ class PPOTrainer(BaseRLTrainer):
 
         self._score_ref_jit = jax.jit(
             self._ref_logprobs,
-            in_shardings=(self.ref_shardings, batch_sh, batch_sh, batch_sh, batch_sh),
+            in_shardings=(
+                self.ref_shardings,
+                self.param_shardings,
+                batch_sh,
+                batch_sh,
+                batch_sh,
+                batch_sh,
+            ),
             out_shardings=batch_sh,
         )
 
@@ -325,7 +377,9 @@ class PPOTrainer(BaseRLTrainer):
         return self._sample_jit(self.state.params, prompt_ids, prompt_mask, key)
 
     def score_ref(self, q_ids, q_mask, r_ids, r_mask):
-        return self._score_ref_jit(self.ref_params, q_ids, q_mask, r_ids, r_mask)
+        return self._score_ref_jit(
+            self.ref_params, self.state.params, q_ids, q_mask, r_ids, r_mask
+        )
 
     def compute_rewards(self, logprobs, ref_logprobs, response_mask, scores):
         rewards, mean_kl = self._compute_rewards_jit(

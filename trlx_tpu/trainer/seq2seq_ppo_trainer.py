@@ -122,7 +122,12 @@ class Seq2SeqPPOTrainer(PPOTrainer):
         logprobs = logprobs_from_logits(out["logits"], mb.response_tokens)
         return logprobs, out["values"].astype(jnp.float32)
 
-    def _ref_logprobs(self, ref_params, q_ids, q_mask, r_ids, r_mask):
+    def _supports_hydra(self) -> bool:
+        # the fork disables the hydra branch for T5 and uses a full frozen
+        # copy (`ppo_orchestrator.py:41-43`)
+        return False
+
+    def _ref_logprobs(self, ref_params, policy_params, q_ids, q_mask, r_ids, r_mask):
         dec_ids, dec_mask = self._decoder_inputs(r_ids, r_mask)
         out = self.backbone.apply(
             {"params": ref_params},
