@@ -1,0 +1,109 @@
+"""Sweep subsystem tests: strategy sampling, param-space build, local
+executor, CLI wiring."""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from trlx_tpu.sweep import (
+    ParamStrategy,
+    get_param_space,
+    get_tune_config,
+    run_local_sweep,
+)
+
+
+def test_all_strategies_sample_in_range():
+    rng = random.Random(0)
+    cases = [
+        ("uniform", [0.1, 0.5], lambda x: 0.1 <= x <= 0.5),
+        ("quniform", [0.0, 1.0, 0.25], lambda x: abs(x / 0.25 - round(x / 0.25)) < 1e-9),
+        ("loguniform", [1e-5, 1e-2], lambda x: 1e-5 <= x <= 1e-2),
+        ("qloguniform", [1e-2, 1.0, 0.01], lambda x: x >= 0.0),
+        ("randn", [0.0, 1.0], lambda x: -6 < x < 6),
+        ("qrandn", [0.0, 1.0, 0.5], lambda x: abs(x / 0.5 - round(x / 0.5)) < 1e-9),
+        ("randint", [2, 10], lambda x: 2 <= x < 10 and isinstance(x, int)),
+        ("qrandint", [0, 100, 10], lambda x: x % 10 == 0),
+        ("lograndint", [1, 1000], lambda x: 1 <= x <= 1000 and isinstance(x, int)),
+        ("qlograndint", [1, 1000, 5], lambda x: x % 5 == 0),
+        ("choice", [["a", "b"]], None),
+        ("grid_search", [[1, 2, 3]], None),
+        ("grid", [[4, 5]], None),
+    ]
+    for strategy, values, check in cases:
+        vals = values if strategy not in ("choice", "grid_search", "grid") else values[0]
+        p = ParamStrategy("x", strategy, vals)
+        for _ in range(50):
+            s = p.sample(rng)
+            if check:
+                assert check(s), (strategy, s)
+            else:
+                assert s in vals
+
+
+def test_unknown_strategy_raises():
+    with pytest.raises(ValueError):
+        ParamStrategy("x", "bogus", [1, 2])
+
+
+def test_param_space_and_tune_config():
+    config = {
+        "tune_config": {"mode": "max", "metric": "m", "num_samples": 3},
+        "lr": {"strategy": "loguniform", "values": [1e-5, 1e-3]},
+        "layers": {"strategy": "grid_search", "values": [2, 4]},
+    }
+    space = get_param_space(config)
+    assert set(space) == {"lr", "layers"}
+    tc = get_tune_config(config)
+    assert tc["num_samples"] == 3 and tc["metric"] == "m"
+
+
+def test_local_sweep_finds_optimum():
+    """Quadratic objective: best trial should be near the optimum."""
+    space = get_param_space(
+        {
+            "x": {"strategy": "uniform", "values": [-2.0, 2.0]},
+            "k": {"strategy": "grid_search", "values": [1.0, 10.0]},
+        }
+    )
+    tc = {"mode": "max", "metric": "score", "num_samples": 40}
+
+    def trainable(params):
+        return {"score": -params["k"] * (params["x"] - 0.5) ** 2}
+
+    best, trials = run_local_sweep(trainable, space, tc, seed=1, log_fn=None)
+    assert len(trials) == 80  # 2 grid x 40 samples
+    assert abs(best["params"]["x"] - 0.5) < 0.2
+
+
+def test_sweep_cli_end_to_end(tmp_path):
+    """Full CLI run against a dummy training script."""
+    import yaml
+
+    from trlx_tpu.sweep.__main__ import cli
+
+    script = tmp_path / "train_script.py"
+    script.write_text(
+        "def main(overrides):\n"
+        "    return {'reward/mean': -abs(overrides['lr_init'] - 1e-4)}\n"
+    )
+    sweep_yml = tmp_path / "sweep.yml"
+    sweep_yml.write_text(
+        yaml.safe_dump(
+            {
+                "tune_config": {"mode": "max", "metric": "reward/mean", "num_samples": 5},
+                "lr_init": {"strategy": "loguniform", "values": [1e-5, 1e-3]},
+            }
+        )
+    )
+    out = tmp_path / "results.json"
+    best = cli(
+        [str(script), "--config", str(sweep_yml), "--local", "--output", str(out)]
+    )
+    assert os.path.exists(out)
+    data = json.load(open(out))
+    assert len(data["trials"]) == 5
+    assert best["result"]["reward/mean"] <= 0

@@ -1,0 +1,204 @@
+"""Hyperparameter sweep subsystem (reference ``trlx/sweep.py`` +
+``trlx/ray_tune/``).
+
+Same YAML schema as the reference (`ray_tune/__init__.py:35-82`): a
+``tune_config`` section (metric/mode/search_alg/scheduler/num_samples) plus
+per-hyperparameter ``{strategy, values}`` entries covering the reference's
+13 strategies. Two executors:
+
+- **Ray Tune** when ray is importable — ``tune.Tuner`` with resources, as
+  the reference (`sweep.py:24-33`);
+- **built-in sequential executor** otherwise: random/grid search running
+  trials in-process (each trial = one ``main(overrides) -> final stats``
+  call), tracking the best config. The reference hard-requires ray; here
+  sweeps degrade gracefully on a bare TPU host.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+STRATEGIES = (
+    "uniform",
+    "quniform",
+    "loguniform",
+    "qloguniform",
+    "randn",
+    "qrandn",
+    "randint",
+    "qrandint",
+    "lograndint",
+    "qlograndint",
+    "choice",
+    "grid_search",
+    "grid",
+)
+
+
+@dataclass
+class ParamStrategy:
+    """One hyperparameter's search strategy (`ray_tune/__init__.py:35-82`)."""
+
+    name: str
+    strategy: str
+    values: List[Any]
+
+    def __post_init__(self):
+        if self.strategy not in STRATEGIES:
+            raise ValueError(
+                f"Unknown strategy {self.strategy!r} for {self.name!r}; "
+                f"valid: {STRATEGIES}"
+            )
+
+    @property
+    def is_grid(self) -> bool:
+        return self.strategy in ("grid_search", "grid", "choice") and self.strategy != "choice"
+
+    def grid_values(self) -> List[Any]:
+        return list(self.values)
+
+    def sample(self, rng: random.Random) -> Any:
+        s, v = self.strategy, self.values
+        if s == "uniform":
+            return rng.uniform(v[0], v[1])
+        if s == "quniform":
+            return round(rng.uniform(v[0], v[1]) / v[2]) * v[2]
+        if s == "loguniform":
+            return math.exp(rng.uniform(math.log(v[0]), math.log(v[1])))
+        if s == "qloguniform":
+            x = math.exp(rng.uniform(math.log(v[0]), math.log(v[1])))
+            return round(x / v[2]) * v[2]
+        if s == "randn":
+            return rng.gauss(v[0], v[1])
+        if s == "qrandn":
+            return round(rng.gauss(v[0], v[1]) / v[2]) * v[2]
+        if s == "randint":
+            return rng.randrange(int(v[0]), int(v[1]))
+        if s == "qrandint":
+            x = rng.randrange(int(v[0]), int(v[1]))
+            q = int(v[2])
+            return (x // q) * q
+        if s == "lograndint":
+            return int(math.exp(rng.uniform(math.log(v[0]), math.log(v[1]))))
+        if s == "qlograndint":
+            x = int(math.exp(rng.uniform(math.log(v[0]), math.log(v[1]))))
+            q = int(v[2])
+            return (x // q) * q
+        if s in ("choice", "grid_search", "grid"):
+            return rng.choice(list(v))
+        raise AssertionError(s)
+
+    def to_ray(self):
+        from ray import tune
+
+        s, v = self.strategy, self.values
+        mapping: Dict[str, Callable] = {
+            "uniform": lambda: tune.uniform(*v),
+            "quniform": lambda: tune.quniform(*v),
+            "loguniform": lambda: tune.loguniform(*v),
+            "qloguniform": lambda: tune.qloguniform(*v),
+            "randn": lambda: tune.randn(*v),
+            "qrandn": lambda: tune.qrandn(*v),
+            "randint": lambda: tune.randint(*v),
+            "qrandint": lambda: tune.qrandint(*v),
+            "lograndint": lambda: tune.lograndint(*v),
+            "qlograndint": lambda: tune.qlograndint(*v),
+            "choice": lambda: tune.choice(v),
+            "grid_search": lambda: tune.grid_search(list(v)),
+            "grid": lambda: tune.grid_search(list(v)),
+        }
+        return mapping[s]()
+
+
+def get_param_space(config: Dict[str, Any]) -> Dict[str, ParamStrategy]:
+    """YAML dict (minus ``tune_config``) -> param strategies
+    (`ray_tune/__init__.py:4-87`)."""
+    space = {}
+    for name, spec in config.items():
+        if name == "tune_config":
+            continue
+        space[name] = ParamStrategy(name, spec["strategy"], spec["values"])
+    return space
+
+
+def get_tune_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize the ``tune_config`` section (`ray_tune/__init__.py:152-165`)."""
+    tune_config = dict(config.get("tune_config", {}))
+    tune_config.setdefault("mode", "max")
+    tune_config.setdefault("metric", "reward/mean")
+    tune_config.setdefault("num_samples", 10)
+    return tune_config
+
+
+def run_local_sweep(
+    trainable: Callable[[Dict[str, Any]], Dict[str, Any]],
+    param_space: Dict[str, ParamStrategy],
+    tune_config: Dict[str, Any],
+    seed: int = 0,
+    log_fn: Optional[Callable[[str], None]] = print,
+) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Built-in executor: grid over grid-strategies x random samples of the
+    rest. Returns (best trial record, all trial records)."""
+    rng = random.Random(seed)
+    metric = tune_config["metric"]
+    mode = tune_config["mode"]
+    num_samples = int(tune_config["num_samples"])
+
+    grid_params = {k: v for k, v in param_space.items() if v.is_grid}
+    rand_params = {k: v for k, v in param_space.items() if not v.is_grid}
+
+    if grid_params:
+        grid_combos = [
+            dict(zip(grid_params, combo))
+            for combo in itertools.product(
+                *(p.grid_values() for p in grid_params.values())
+            )
+        ]
+    else:
+        grid_combos = [{}]
+
+    trials: List[Dict[str, Any]] = []
+    for combo in grid_combos:
+        for _ in range(num_samples if rand_params else 1):
+            params = dict(combo)
+            params.update({k: p.sample(rng) for k, p in rand_params.items()})
+            result = trainable(dict(params)) or {}
+            record = {"params": params, "result": result}
+            trials.append(record)
+            if log_fn:
+                log_fn(f"[sweep] trial {len(trials)}: {params} -> "
+                       f"{metric}={result.get(metric)}")
+
+    def key(t):
+        v = t["result"].get(metric)
+        if v is None:
+            return -float("inf") if mode == "max" else float("inf")
+        return v
+
+    best = max(trials, key=key) if mode == "max" else min(trials, key=key)
+    if log_fn:
+        log_fn(f"[sweep] best: {best['params']} -> {best['result'].get(metric)}")
+    return best, trials
+
+
+def run_ray_sweep(trainable, param_space, tune_config, num_cpus=4, num_gpus=0):
+    """Ray Tune executor (`sweep.py:21-49`); requires ray installed."""
+    import ray
+    from ray import tune
+
+    ray.init(ignore_reinit_error=True)
+    tuner = tune.Tuner(
+        tune.with_resources(trainable, resources={"cpu": num_cpus, "gpu": num_gpus}),
+        param_space={k: p.to_ray() for k, p in param_space.items()},
+        tune_config=tune.TuneConfig(
+            mode=tune_config["mode"],
+            metric=tune_config["metric"],
+            num_samples=tune_config["num_samples"],
+        ),
+    )
+    results = tuner.fit()
+    return results.get_best_result(), results

@@ -1,0 +1,90 @@
+"""Sweep CLI: ``python -m trlx_tpu.sweep --config sweep.yml script.py``.
+
+Reference ``trlx/sweep.py:52-113``: imports the user script's ``main`` as
+the trainable (called with a dict of hyperparameter overrides; it applies
+them via ``TRLConfig.update`` and returns final stats), builds the param
+space from the sweep YAML, and runs trials — on Ray when available, else
+the built-in sequential executor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+import yaml
+
+from trlx_tpu.sweep import (
+    get_param_space,
+    get_tune_config,
+    run_local_sweep,
+    run_ray_sweep,
+)
+
+
+def import_main(script_path: str):
+    """Import the user script's ``main`` (`sweep.py:106-110`)."""
+    spec = importlib.util.spec_from_file_location("sweep_script", script_path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script_path)))
+    spec.loader.exec_module(module)
+    if not hasattr(module, "main"):
+        raise ValueError(f"{script_path} must define main(overrides: dict)")
+    return module.main
+
+
+def cli(argv=None):
+    parser = argparse.ArgumentParser(description="trlx_tpu hyperparameter sweep")
+    parser.add_argument("script", help="training script defining main(overrides)")
+    parser.add_argument("--config", required=True, help="sweep YAML")
+    parser.add_argument("--num-cpus", type=int, default=4)
+    parser.add_argument("--num-gpus", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--output", default="sweep_results.json", help="trial records output"
+    )
+    parser.add_argument(
+        "--local",
+        action="store_true",
+        help="force the built-in executor even if ray is installed",
+    )
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        sweep_config = yaml.safe_load(f)
+    param_space = get_param_space(sweep_config)
+    tune_config = get_tune_config(sweep_config)
+    trainable = import_main(args.script)
+
+    use_ray = not args.local
+    if use_ray:
+        try:
+            import ray  # noqa: F401
+        except ImportError:
+            use_ray = False
+
+    if use_ray:
+        best, results = run_ray_sweep(
+            trainable, param_space, tune_config, args.num_cpus, args.num_gpus
+        )
+        print(f"best config: {best.config}")
+    else:
+        best, trials = run_local_sweep(
+            trainable, param_space, tune_config, seed=args.seed
+        )
+        with open(args.output, "w") as f:
+            json.dump({"best": best, "trials": trials}, f, indent=2, default=float)
+        try:
+            from trlx_tpu.sweep.wandb_report import log_trials
+
+            log_trials(trials, tune_config)
+        except Exception:
+            pass
+    return best
+
+
+if __name__ == "__main__":
+    cli()

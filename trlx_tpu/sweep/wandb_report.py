@@ -1,0 +1,72 @@
+"""Sweep -> wandb reporting (reference ``trlx/ray_tune/wandb.py``).
+
+``log_trials`` replays trial records into wandb runs (`wandb.py:47-82`);
+``create_report`` builds a programmatic W&B report — parallel coordinates,
+parameter importance, per-metric scatter (`wandb.py:85-214`). Both are
+no-ops when wandb isn't installed or is disabled.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+
+def _wandb():
+    if os.environ.get("WANDB_DISABLED", "") in ("1", "true"):
+        return None
+    try:
+        import wandb
+
+        return wandb
+    except ImportError:
+        return None
+
+
+def log_trials(trials: List[Dict[str, Any]], tune_config: Dict[str, Any],
+               project: str = "trlx_tpu-sweeps") -> None:
+    """One wandb run per trial, config = params, summary = final result."""
+    wandb = _wandb()
+    if wandb is None:
+        return
+    for i, trial in enumerate(trials):
+        run = wandb.init(
+            project=project,
+            name=f"trial-{i}",
+            config=trial["params"],
+            reinit=True,
+            mode=os.environ.get("WANDB_MODE", "offline"),
+        )
+        run.log(trial["result"])
+        run.finish()
+
+
+def create_report(project: str, param_space: Dict[str, Any],
+                  metric: str, trials: List[Dict[str, Any]],
+                  best: Dict[str, Any]) -> None:
+    """Programmatic W&B report (requires wandb + the report API)."""
+    wandb = _wandb()
+    if wandb is None:
+        return
+    try:
+        import wandb.apis.reports as wb
+    except Exception:
+        return
+    report = wb.Report(
+        project=project,
+        title=f"Sweep report: {metric}",
+        description=f"best params: {best.get('params')}",
+    )
+    pg = wb.PanelGrid(
+        runsets=[wb.Runset(project=project)],
+        panels=[
+            wb.ParallelCoordinatesPlot(
+                columns=[wb.PCColumn(f"c::{p}") for p in param_space]
+                + [wb.PCColumn(metric)],
+            ),
+            wb.ParameterImportancePlot(with_respect_to=metric),
+            wb.ScatterPlot(x="created", y=metric),
+        ],
+    )
+    report.blocks = [pg]
+    report.save()
