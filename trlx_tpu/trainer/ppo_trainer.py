@@ -460,6 +460,7 @@ class PPOTrainer(BaseRLTrainer):
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
                     logger.finish()
+                    self._final_stats = final_stats
                     return final_stats
             # on-policy refresh (post_epoch_callback,
             # `accelerate_ppo_model.py:130-134`)
@@ -467,6 +468,7 @@ class PPOTrainer(BaseRLTrainer):
                 self.buffer.clear_history()
                 self.orch.make_experience(method.num_rollouts, iter_count)
         logger.finish()
+        self._final_stats = final_stats
         return final_stats
 
     # ------------------------------------------------------------------ #
