@@ -221,6 +221,159 @@ def load_t5_checkpoint(model_path: str, dtype: str = "float32"):
     return config, params
 
 
+def gptj_config_from_hf(path_or_dict) -> "GPTJConfig":
+    from trlx_tpu.models.gptj import GPTJConfig
+
+    if isinstance(path_or_dict, (str, os.PathLike)):
+        with open(os.path.join(path_or_dict, "config.json")) as f:
+            d = json.load(f)
+    elif hasattr(path_or_dict, "to_dict"):
+        d = path_or_dict.to_dict()
+    else:
+        d = dict(path_or_dict)
+    return GPTJConfig(
+        vocab_size=d["vocab_size"],
+        n_positions=d.get("n_positions", 2048),
+        n_embd=d["n_embd"],
+        n_layer=d["n_layer"],
+        n_head=d["n_head"],
+        rotary_dim=d.get("rotary_dim") or (d["n_embd"] // d["n_head"]),
+        layer_norm_epsilon=d.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def convert_gptj_state_dict(
+    state_dict: Mapping[str, Any], config, dtype: str = "float32"
+) -> Dict[str, Any]:
+    """HF ``GPTJForCausalLM`` -> ``GPTJModel`` params (Linear kernels
+    transpose; lm_head is untied with bias)."""
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    cast = lambda t: jnp.asarray(_np(t), dtype=jnp.dtype(dtype))
+    castT = lambda t: jnp.asarray(_np(t).T.copy(), dtype=jnp.dtype(dtype))
+
+    params: Dict[str, Any] = {
+        "wte": {"embedding": cast(sd["wte.weight"])},
+        "ln_f": {"scale": cast(sd["ln_f.weight"]), "bias": cast(sd["ln_f.bias"])},
+        "lm_head": {
+            "kernel": castT(sd["lm_head.weight"]),
+            "bias": cast(sd["lm_head.bias"]),
+        },
+    }
+    for i in range(config.n_layer):
+        p = f"h.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": cast(sd[p + "ln_1.weight"]), "bias": cast(sd[p + "ln_1.bias"])},
+            "attn": {
+                "q_proj": {"kernel": castT(sd[p + "attn.q_proj.weight"])},
+                "k_proj": {"kernel": castT(sd[p + "attn.k_proj.weight"])},
+                "v_proj": {"kernel": castT(sd[p + "attn.v_proj.weight"])},
+                "out_proj": {"kernel": castT(sd[p + "attn.out_proj.weight"])},
+            },
+            "mlp": {
+                "fc_in": {
+                    "kernel": castT(sd[p + "mlp.fc_in.weight"]),
+                    "bias": cast(sd[p + "mlp.fc_in.bias"]),
+                },
+                "fc_out": {
+                    "kernel": castT(sd[p + "mlp.fc_out.weight"]),
+                    "bias": cast(sd[p + "mlp.fc_out.bias"]),
+                },
+            },
+        }
+    return params
+
+
+def load_gptj_checkpoint(model_path: str, dtype: str = "float32"):
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_path, local_files_only=True)
+    config = gptj_config_from_hf(model.config)
+    return config, convert_gptj_state_dict(model.state_dict(), config, dtype)
+
+
+def neox_config_from_hf(path_or_dict) -> "NeoXConfig":
+    from trlx_tpu.models.neox import NeoXConfig
+
+    if isinstance(path_or_dict, (str, os.PathLike)):
+        with open(os.path.join(path_or_dict, "config.json")) as f:
+            d = json.load(f)
+    elif hasattr(path_or_dict, "to_dict"):
+        d = path_or_dict.to_dict()
+    else:
+        d = dict(path_or_dict)
+    return NeoXConfig(
+        vocab_size=d["vocab_size"],
+        max_position_embeddings=d.get("max_position_embeddings", 2048),
+        hidden_size=d["hidden_size"],
+        num_hidden_layers=d["num_hidden_layers"],
+        num_attention_heads=d["num_attention_heads"],
+        rotary_pct=d.get("rotary_pct", 0.25),
+        rotary_emb_base=d.get("rotary_emb_base", 10000.0),
+        use_parallel_residual=d.get("use_parallel_residual", True),
+        layer_norm_eps=d.get("layer_norm_eps", 1e-5),
+    )
+
+
+def convert_neox_state_dict(
+    state_dict: Mapping[str, Any], config, dtype: str = "float32"
+) -> Dict[str, Any]:
+    """HF ``GPTNeoXForCausalLM`` -> ``NeoXModel`` params. The fused QKV
+    kernel keeps HF's head-major [H, 3*Dh] output layout (transpose only)."""
+    sd = {k.removeprefix("gpt_neox."): v for k, v in state_dict.items()}
+    cast = lambda t: jnp.asarray(_np(t), dtype=jnp.dtype(dtype))
+    castT = lambda t: jnp.asarray(_np(t).T.copy(), dtype=jnp.dtype(dtype))
+
+    params: Dict[str, Any] = {
+        "wte": {"embedding": cast(sd["embed_in.weight"])},
+        "ln_f": {
+            "scale": cast(sd["final_layer_norm.weight"]),
+            "bias": cast(sd["final_layer_norm.bias"]),
+        },
+        "lm_head": {"kernel": castT(sd["embed_out.weight"])},
+    }
+    for i in range(config.num_hidden_layers):
+        p = f"layers.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {
+                "scale": cast(sd[p + "input_layernorm.weight"]),
+                "bias": cast(sd[p + "input_layernorm.bias"]),
+            },
+            "ln_2": {
+                "scale": cast(sd[p + "post_attention_layernorm.weight"]),
+                "bias": cast(sd[p + "post_attention_layernorm.bias"]),
+            },
+            "attn": {
+                "query_key_value": {
+                    "kernel": castT(sd[p + "attention.query_key_value.weight"]),
+                    "bias": cast(sd[p + "attention.query_key_value.bias"]),
+                },
+                "dense": {
+                    "kernel": castT(sd[p + "attention.dense.weight"]),
+                    "bias": cast(sd[p + "attention.dense.bias"]),
+                },
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "kernel": castT(sd[p + "mlp.dense_h_to_4h.weight"]),
+                    "bias": cast(sd[p + "mlp.dense_h_to_4h.bias"]),
+                },
+                "dense_4h_to_h": {
+                    "kernel": castT(sd[p + "mlp.dense_4h_to_h.weight"]),
+                    "bias": cast(sd[p + "mlp.dense_4h_to_h.bias"]),
+                },
+            },
+        }
+    return params
+
+
+def load_neox_checkpoint(model_path: str, dtype: str = "float32"):
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_path, local_files_only=True)
+    config = neox_config_from_hf(model.config)
+    return config, convert_neox_state_dict(model.state_dict(), config, dtype)
+
+
 def load_gpt2_checkpoint(model_path: str, dtype: str = "float32"):
     """Load an on-disk HF GPT-2 checkpoint -> (GPT2Config, param tree).
 

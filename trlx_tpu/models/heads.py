@@ -47,16 +47,21 @@ class MLPHead(nn.Module):
 class CausalLMWithValueHead(nn.Module):
     """Causal LM backbone + scalar value head (PPO policy).
 
-    Values are computed in float32 (the head's final layer) — value-loss
-    clipping is sensitive to bf16 rounding.
+    ``backbone_cls`` may be any causal family module with the shared call
+    interface (GPT2Model / GPTJModel / NeoXModel). Values are computed in
+    float32 (the head's final layer) — value-loss clipping is sensitive to
+    bf16 rounding.
     """
 
-    config: GPT2Config
+    config: Any
+    backbone_cls: Any = GPT2Model
 
     def setup(self):
-        self.backbone = GPT2Model(self.config, name="transformer")
+        from trlx_tpu.models.registry import hidden_size_of
+
+        self.backbone = self.backbone_cls(self.config, name="transformer")
         self.v_head = MLPHead(
-            self.config.n_embd,
+            hidden_size_of(self.config),
             1,
             dtype=self.config.dtype,
             param_dtype=self.config.param_dtype,
@@ -175,11 +180,13 @@ class ILQLHeads(nn.Module):
     ``target_q_heads`` submodules + ZeRO-gather sync (`ilql_models.py:170-181`).
     """
 
-    config: GPT2Config
+    config: Any
     two_qs: bool = True
 
     def setup(self):
-        n = self.config.n_embd
+        from trlx_tpu.models.registry import hidden_size_of
+
+        n = hidden_size_of(self.config)
         v = self.config.vocab_size
         kw = dict(dtype=self.config.dtype, param_dtype=self.config.param_dtype)
         self.q_heads = [
@@ -208,11 +215,12 @@ class CausalLMWithILQLHeads(nn.Module):
     with the target param tree held in the ILQL train state.
     """
 
-    config: GPT2Config
+    config: Any
     two_qs: bool = True
+    backbone_cls: Any = GPT2Model
 
     def setup(self):
-        self.backbone = GPT2Model(self.config, name="transformer")
+        self.backbone = self.backbone_cls(self.config, name="transformer")
         self.ilql_heads = ILQLHeads(self.config, self.two_qs, name="heads")
 
     def __call__(

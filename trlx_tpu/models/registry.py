@@ -1,0 +1,106 @@
+"""Model-family registry: ``model.model_type`` string -> architecture kit.
+
+The reference hardwires architectures per trainer (`accelerate_ppo_model.py
+:56-59` -> T5; `ilql_models.py:187` -> AutoModelForCausalLM). Here every
+causal family (gpt2, gptj, gpt_neox) exposes one uniform kit — config class,
+backbone module (same call interface), TP partition rules, KV-cache factory,
+checkpoint loader — so trainers are family-agnostic; seq2seq (t5) has its
+own trainer subclass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class ModelFamily:
+    name: str
+    config_cls: type
+    backbone_cls: type
+    partition_rules: Sequence
+    init_cache: Callable  # (config, batch, capacity) -> cache
+    load_checkpoint: Callable  # (path, dtype) -> (config, params)
+    is_seq2seq: bool = False
+
+
+_FAMILIES: Dict[str, ModelFamily] = {}
+
+
+def register_model_family(family: ModelFamily, *aliases: str) -> ModelFamily:
+    for key in (family.name, *aliases):
+        _FAMILIES[key.lower()] = family
+    return family
+
+
+def get_model_family(name: str) -> ModelFamily:
+    key = name.lower()
+    if key not in _FAMILIES:
+        _register_builtins()
+    if key in _FAMILIES:
+        return _FAMILIES[key]
+    raise ValueError(
+        f"Unknown model_type: {name!r}. Registered: {sorted(_FAMILIES)}"
+    )
+
+
+def hidden_size_of(config: Any) -> int:
+    for attr in ("n_embd", "hidden_size", "d_model"):
+        if hasattr(config, attr):
+            return getattr(config, attr)
+    raise ValueError(f"no hidden size on {type(config).__name__}")
+
+
+def num_layers_of(config: Any) -> int:
+    for attr in ("n_layer", "num_hidden_layers", "num_decoder_layers"):
+        if hasattr(config, attr):
+            return getattr(config, attr)
+    raise ValueError(f"no layer count on {type(config).__name__}")
+
+
+def _register_builtins() -> None:
+    from trlx_tpu.models import conversion
+    from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model, PARTITION_RULES, init_cache
+    from trlx_tpu.models.gptj import (
+        GPTJConfig,
+        GPTJModel,
+        GPTJ_PARTITION_RULES,
+        init_gptj_cache,
+    )
+    from trlx_tpu.models.neox import (
+        NeoXConfig,
+        NeoXModel,
+        NEOX_PARTITION_RULES,
+        init_neox_cache,
+    )
+    from trlx_tpu.models.t5 import T5Config, T5Model, T5_PARTITION_RULES, init_t5_cache
+
+    register_model_family(
+        ModelFamily(
+            "gpt2", GPT2Config, GPT2Model, PARTITION_RULES, init_cache,
+            conversion.load_gpt2_checkpoint,
+        )
+    )
+    register_model_family(
+        ModelFamily(
+            "gptj", GPTJConfig, GPTJModel, GPTJ_PARTITION_RULES, init_gptj_cache,
+            conversion.load_gptj_checkpoint,
+        ),
+        "gpt-j",
+    )
+    register_model_family(
+        ModelFamily(
+            "gpt_neox", NeoXConfig, NeoXModel, NEOX_PARTITION_RULES, init_neox_cache,
+            conversion.load_neox_checkpoint,
+        ),
+        "neox",
+        "gpt-neox",
+    )
+    register_model_family(
+        ModelFamily(
+            "t5", T5Config, T5Model, T5_PARTITION_RULES, init_t5_cache,
+            conversion.load_t5_checkpoint, is_seq2seq=True,
+        ),
+        "ul2",
+    )

@@ -1,0 +1,59 @@
+"""Rotary position embeddings — both conventions.
+
+GPT-J rotates interleaved pairs (``rotate_every_two``); GPT-NeoX rotates
+concatenated halves (``rotate_half``). Getting the convention right per
+family is what exact-logit checkpoint parity hinges on (verified in
+``tests/test_gptj_parity.py`` / ``test_neox_parity.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rotary_angles(
+    position_ids: jax.Array,  # [B, T]
+    rotary_dim: int,
+    base: float = 10000.0,
+):
+    """-> (sin, cos) of shape [B, T, rotary_dim/2], float32."""
+    inv_freq = 1.0 / (
+        base ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim)
+    )
+    angles = position_ids.astype(jnp.float32)[..., None] * inv_freq  # [B, T, D/2]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rotary_interleaved(
+    x: jax.Array,  # [B, T, H, D] (first rotary_dim dims rotated)
+    sin: jax.Array,  # [B, T, rotary_dim/2]
+    cos: jax.Array,
+    rotary_dim: int,
+) -> jax.Array:
+    """GPT-J convention: pairs (x0,x1),(x2,x3),... rotate together."""
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    sin2 = jnp.repeat(sin, 2, axis=-1)[:, :, None, :]  # [B, T, 1, rotary_dim]
+    cos2 = jnp.repeat(cos, 2, axis=-1)[:, :, None, :]
+    x1 = rot[..., ::2]
+    x2 = rot[..., 1::2]
+    rotated = jnp.stack([-x2, x1], axis=-1).reshape(rot.shape)
+    rot = rot * cos2.astype(x.dtype) + rotated * sin2.astype(x.dtype)
+    return jnp.concatenate([rot, rest], axis=-1) if rest.shape[-1] else rot
+
+
+def apply_rotary_half(
+    x: jax.Array,  # [B, T, H, D]
+    sin: jax.Array,  # [B, T, rotary_dim/2]
+    cos: jax.Array,
+    rotary_dim: int,
+) -> jax.Array:
+    """GPT-NeoX convention: first and second halves of the rotary dims
+    rotate against each other."""
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    sin2 = jnp.concatenate([sin, sin], axis=-1)[:, :, None, :]
+    cos2 = jnp.concatenate([cos, cos], axis=-1)[:, :, None, :]
+    half = rotary_dim // 2
+    rotated = jnp.concatenate([-rot[..., half:], rot[..., :half]], axis=-1)
+    rot = rot * cos2.astype(x.dtype) + rotated * sin2.astype(x.dtype)
+    return jnp.concatenate([rot, rest], axis=-1) if rest.shape[-1] else rot

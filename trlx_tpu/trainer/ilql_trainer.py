@@ -27,8 +27,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ilql_types import ILQLBatch
-from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model, PARTITION_RULES, init_cache
 from trlx_tpu.models.heads import CausalLMWithILQLHeads
+from trlx_tpu.models.registry import num_layers_of
 from trlx_tpu.ops.ilql_math import ILQLConfig, ilql_loss, polyak_update
 from trlx_tpu.ops.sampling import GenerationConfig, make_sampler
 from trlx_tpu.parallel import (
@@ -83,8 +83,14 @@ class ILQLTrainer(BaseRLTrainer):
             if self.tokenizer.pad_token_id is None:
                 self.tokenizer.pad_token = self.tokenizer.eos_token
 
-        self.model_config, init_params = get_gpt2_arch(config)
-        self.model = CausalLMWithILQLHeads(self.model_config, two_qs=method.two_qs)
+        from trlx_tpu.trainer.ppo_trainer import get_causal_arch
+
+        self.family, self.model_config, init_params = get_causal_arch(config)
+        self.model = CausalLMWithILQLHeads(
+            self.model_config,
+            two_qs=method.two_qs,
+            backbone_cls=self.family.backbone_cls,
+        )
 
         gen_kwargs = {"max_new_tokens": 48, "do_sample": True, "top_k": 20}
         if self.tokenizer is not None:
@@ -114,7 +120,7 @@ class ILQLTrainer(BaseRLTrainer):
         target_q = jax.device_put(target_q, self.target_shardings)
 
         trainable = unfrozen_param_mask(
-            params, config.model.num_layers_unfrozen, self.model_config.n_layer
+            params, config.model.num_layers_unfrozen, num_layers_of(self.model_config)
         )
         self.tx = make_optimizer(train, train.total_steps, trainable)
         opt_shapes = jax.eval_shape(self.tx.init, params)
@@ -138,7 +144,7 @@ class ILQLTrainer(BaseRLTrainer):
         self._build_jitted_fns()
 
     def _shardings_for(self, tree):
-        specs = make_partition_specs(tree, self.mesh, PARTITION_RULES)
+        specs = make_partition_specs(tree, self.mesh, self.family.partition_rules)
         return jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s),
             specs,
@@ -235,7 +241,7 @@ class ILQLTrainer(BaseRLTrainer):
 
         sampler = make_sampler(
             sample_apply,
-            functools.partial(init_cache, self.model_config),
+            functools.partial(self.family.init_cache, self.model_config),
             self.gen_config,
             self.query_length,
             with_values=False,

@@ -62,28 +62,35 @@ from trlx_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 from trlx_tpu.utils.logging import Logger
 
 
-def get_gpt2_arch(config: TRLConfig):
-    """Model config + (optional) converted checkpoint params for the policy
-    backbone (reference ``get_arch``, `accelerate_ppo_model.py:56-59`)."""
-    model_cfg = config.model
-    overrides = dict(model_cfg.model_arch)
+def get_causal_arch(config: TRLConfig):
+    """(family, arch config, optional converted checkpoint params) for the
+    configured causal model_type (reference ``get_arch``,
+    `accelerate_ppo_model.py:56-59`, generalized over gpt2/gptj/gpt_neox)."""
+    from trlx_tpu.models.registry import get_model_family
+
+    family = get_model_family(config.model.model_type)
+    overrides = dict(config.model.model_arch)
     overrides.setdefault("dtype", config.train.dtype)
     overrides.setdefault("param_dtype", config.train.param_dtype)
-    if model_cfg.model_path:
-        from trlx_tpu.models.conversion import load_gpt2_checkpoint
-
-        arch, params = load_gpt2_checkpoint(
-            model_cfg.model_path, dtype=config.train.param_dtype
+    if config.model.model_path:
+        arch, params = family.load_checkpoint(
+            config.model.model_path, dtype=config.train.param_dtype
         )
-        arch = GPT2Config(
+        arch = type(arch)(
             **{
                 **arch.__dict__,
                 "dtype": overrides["dtype"],
                 "param_dtype": overrides["param_dtype"],
             }
         )
-        return arch, params
-    return GPT2Config.from_dict(overrides), None
+        return family, arch, params
+    return family, family.config_cls.from_dict(overrides), None
+
+
+def get_gpt2_arch(config: TRLConfig):
+    """Back-compat shim; prefer :func:`get_causal_arch`."""
+    _, arch, params = get_causal_arch(config)
+    return arch, params
 
 
 @register_trainer
@@ -150,10 +157,12 @@ class PPOTrainer(BaseRLTrainer):
         if self.use_hydra:
             self.branch_start = self._n_layers() - config.model.num_layers_unfrozen
             backbone = params[self.backbone_key]
+            # keep top-k blocks + everything the LM head path needs (ln_f,
+            # tied wte or untied lm_head); drop trunk blocks + wpe
             ref_subset = {
                 k: v
                 for k, v in backbone.items()
-                if k in ("wte", "ln_f")
+                if not k.startswith(("h_", "wpe"))
                 or (k.startswith("h_") and int(k.split("_")[1]) >= self.branch_start)
             }
             self.ref_shardings = self._shardings_for(ref_subset)
@@ -195,17 +204,21 @@ class PPOTrainer(BaseRLTrainer):
     def _setup_model(self):
         """Build arch config + flax modules; return converted checkpoint
         params (or None)."""
-        self.model_config, init_params = get_gpt2_arch(self.config)
-        self.model = CausalLMWithValueHead(self.model_config)
-        self.backbone = GPT2Model(self.model_config)
-        self.partition_rules = PARTITION_RULES
+        self.family, self.model_config, init_params = get_causal_arch(self.config)
+        self.model = CausalLMWithValueHead(
+            self.model_config, backbone_cls=self.family.backbone_cls
+        )
+        self.backbone = self.family.backbone_cls(self.model_config)
+        self.partition_rules = self.family.partition_rules
         return init_params
 
     def _amend_gen_kwargs(self, gen_kwargs: Dict) -> None:
         pass
 
     def _n_layers(self) -> int:
-        return self.model_config.n_layer
+        from trlx_tpu.models.registry import num_layers_of
+
+        return num_layers_of(self.model_config)
 
     def _init_params(self, rng):
         dummy = jnp.zeros((1, 8), jnp.int32)
@@ -227,7 +240,7 @@ class PPOTrainer(BaseRLTrainer):
 
         return make_sampler(
             apply_fn,
-            functools.partial(init_cache, self.model_config),
+            functools.partial(self.family.init_cache, self.model_config),
             self.gen_config,
             self.query_length,
             with_values=True,

@@ -63,6 +63,9 @@ class Seq2SeqPPOTrainer(PPOTrainer):
     backbone_key = "t5"
 
     def _setup_model(self):
+        from trlx_tpu.models.registry import get_model_family
+
+        self.family = get_model_family("t5")
         self.model_config, init_params = get_t5_arch(self.config)
         self.model = T5WithValueHead(self.model_config)
         self.backbone = T5Model(self.model_config)
