@@ -1,0 +1,86 @@
+"""Ring attention correctness: sequence-sharded exact attention over an sp
+mesh axis must match single-device dense attention bit-for-bit (up to fp
+accumulation), including causal masking and KV padding."""
+
+import numpy as np
+import pytest
+
+
+def dense_reference(q, k, v, kv_mask, causal):
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.attention import (
+        causal_bias,
+        combine_biases,
+        dot_product_attention,
+        padding_bias,
+    )
+
+    bias = combine_biases(
+        causal_bias(q.shape[1], k.shape[1]) if causal else None,
+        padding_bias(jnp.asarray(kv_mask)),
+    )
+    return dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), bias
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_matches_dense(causal, sp):
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.ring_attention import ring_attention_sharded
+    from trlx_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": -1, "fsdp": 1, "tp": 1, "sp": sp})
+    rng = np.random.default_rng(0)
+    B, T, H, D = 8 // sp * 2, 16, 2, 8
+    B = max(B, 2)
+    q = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    kv_mask = np.ones((B, T), np.int32)
+    kv_mask[0, T - 3 :] = 0  # padded tail on one row
+
+    out = ring_attention_sharded(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh,
+        kv_mask=jnp.asarray(kv_mask), causal=causal,
+    )
+    expected = dense_reference(q, k, v, kv_mask, causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=2e-5, rtol=1e-4
+    )
+
+
+def test_ring_attention_jits_and_grads():
+    """The sharded ring attention composes with jit and autodiff (needed to
+    train with sequence parallelism, not just infer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.ring_attention import ring_attention_sharded
+    from trlx_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": -1, "fsdp": 1, "tp": 1, "sp": 4})
+    rng = np.random.default_rng(1)
+    B, T, H, D = 2, 8, 2, 4
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+
+    def loss(q, k, v):
+        out = ring_attention_sharded(q, k, v, mesh, causal=True)
+        return jnp.sum(out**2)
+
+    g = jax.jit(jax.grad(loss))(q, k, v)
+    assert np.isfinite(np.asarray(g)).all()
+
+    # gradient sanity vs dense reference grad
+    def dense_loss(q, k, v):
+        out = dense_reference(q, k, v, np.ones((B, T), np.int32), True)
+        return jnp.sum(out**2)
+
+    g_dense = jax.grad(dense_loss)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_dense), atol=1e-4)

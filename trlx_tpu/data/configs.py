@@ -110,6 +110,9 @@ class TrainConfig:
     param_dtype: str = "float32"
 
     rollout_logging_dir: Optional[str] = None
+    # write a jax.profiler trace of the first ~10 optimizer steps here
+    # (SURVEY §5.1: timing stats + optional jax.profiler integration)
+    profile_dir: Optional[str] = None
     tags: List[str] = field(default_factory=list)
 
     @classmethod

@@ -1,0 +1,72 @@
+"""Multi-host runtime: process init, barriers, host-side data exchange.
+
+The TPU-pod replacement for the reference's process bootstrap
+(``accelerate launch`` + WORLD_SIZE/LOCAL_RANK env + startup
+``dist.barrier``, `accelerate_base_model.py:40-41`, SURVEY §2.9): one
+process per host, ``jax.distributed.initialize`` wires the DCN control
+plane, and all device-side collectives ride ICI automatically via GSPMD.
+Host-side sync points use ``jax.experimental.multihost_utils``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the multi-host runtime (no-op single-process).
+
+    On TPU pods, all arguments are auto-detected from the TPU metadata; on
+    other platforms provide them explicitly or via
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    explicit = coordinator_address is not None
+    on_tpu_pod = any(d.platform == "tpu" for d in jax.local_devices()) and (
+        os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") > 0
+    )
+    if explicit or on_tpu_pod:
+        kwargs = {}
+        if explicit:
+            kwargs = dict(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes
+                or int(os.environ.get("JAX_NUM_PROCESSES", 1)),
+                process_id=process_id or int(os.environ.get("JAX_PROCESS_ID", 0)),
+            )
+        jax.distributed.initialize(**kwargs)
+
+
+def barrier(name: str = "sync") -> None:
+    """Cross-host barrier (reference startup barrier,
+    `accelerate_base_model.py:40-41`)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def is_main_process() -> bool:
+    """Rank-0 gating for logging/IO (reference ``is_main_process``)."""
+    return jax.process_index() == 0
+
+
+def broadcast_host_value(value: Any):
+    """Broadcast a host-side python value from process 0 (used for e.g.
+    host-RNG-derived decisions that must agree across hosts)."""
+    if jax.process_count() <= 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value)

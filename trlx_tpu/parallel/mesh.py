@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
+AXIS_SP = "sp"  # sequence/context parallel (ring attention, ops/ring_attention.py)
 # Batch axes: data is sharded over both dp and fsdp mesh axes.
 BATCH_AXES = (AXIS_DP, AXIS_FSDP)
 
@@ -51,6 +52,7 @@ def make_mesh(
         AXIS_DP: mesh_config.get(AXIS_DP, -1),
         AXIS_FSDP: mesh_config.get(AXIS_FSDP, 1),
         AXIS_TP: mesh_config.get(AXIS_TP, 1),
+        AXIS_SP: mesh_config.get(AXIS_SP, 1),
     }
     unknown = set(mesh_config) - set(sizes)
     if unknown:
@@ -69,9 +71,9 @@ def make_mesh(
     elif fixed != n:
         raise ValueError(f"Mesh {sizes} needs {fixed} devices, have {n}")
 
-    shape = (sizes[AXIS_DP], sizes[AXIS_FSDP], sizes[AXIS_TP])
+    shape = (sizes[AXIS_DP], sizes[AXIS_FSDP], sizes[AXIS_TP], sizes[AXIS_SP])
     device_array = np.asarray(devices).reshape(shape)
-    return Mesh(device_array, (AXIS_DP, AXIS_FSDP, AXIS_TP))
+    return Mesh(device_array, (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

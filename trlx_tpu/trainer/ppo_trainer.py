@@ -436,6 +436,10 @@ class PPOTrainer(BaseRLTrainer):
         clock = Clock()
         iter_count = 0
         final_stats: Dict[str, Any] = {}
+        profiling = False
+        if train.profile_dir:
+            jax.profiler.start_trace(train.profile_dir)
+            profiling = True
         for epoch in range(train.epochs):
             for mb in self.buffer.create_loader(
                 train.batch_size,
@@ -457,6 +461,11 @@ class PPOTrainer(BaseRLTrainer):
                 step_stats["policy/kl_coef"] = self.kl_coef
                 step_stats["policy/mean_rollout_kl"] = self.mean_kl
 
+                if profiling and iter_count >= 10:
+                    jax.block_until_ready(self.state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
+
                 iv = self.intervals(iter_count)
                 if iv["do_log"]:
                     logger.log(step_stats, step=iter_count)
@@ -468,6 +477,9 @@ class PPOTrainer(BaseRLTrainer):
                 if iv["do_save"]:
                     self.save()
                 if iter_count >= total_steps:
+                    if profiling:
+                        jax.profiler.stop_trace()
+                        profiling = False
                     self.save()
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)
@@ -480,6 +492,8 @@ class PPOTrainer(BaseRLTrainer):
             if self.orch is not None and epoch < train.epochs - 1:
                 self.buffer.clear_history()
                 self.orch.make_experience(method.num_rollouts, iter_count)
+        if profiling:
+            jax.profiler.stop_trace()
         logger.finish()
         self._final_stats = final_stats
         return final_stats
