@@ -65,6 +65,17 @@ def test_ppo_gptj_family():
     )
 
 
+def test_ppo_gpt_neo_family():
+    _run_ppo(
+        "gpt_neo",
+        {
+            "vocab_size": 32, "max_position_embeddings": 16, "hidden_size": 32,
+            "num_layers": 2, "num_heads": 2, "window_size": 3,
+            "attention_layers": ["global", "local"],
+        },
+    )
+
+
 def test_ppo_neox_family():
     _run_ppo(
         "gpt_neox",

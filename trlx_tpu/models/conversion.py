@@ -374,6 +374,86 @@ def load_neox_checkpoint(model_path: str, dtype: str = "float32"):
     return config, convert_neox_state_dict(model.state_dict(), config, dtype)
 
 
+def gpt_neo_config_from_hf(path_or_dict) -> "GPTNeoConfig":
+    from trlx_tpu.models.gpt_neo import GPTNeoConfig, expand_attention_types
+
+    if isinstance(path_or_dict, (str, os.PathLike)):
+        with open(os.path.join(path_or_dict, "config.json")) as f:
+            d = json.load(f)
+    elif hasattr(path_or_dict, "to_dict"):
+        d = path_or_dict.to_dict()
+    else:
+        d = dict(path_or_dict)
+    return GPTNeoConfig(
+        vocab_size=d["vocab_size"],
+        max_position_embeddings=d.get("max_position_embeddings", 2048),
+        hidden_size=d["hidden_size"],
+        num_layers=d["num_layers"],
+        num_heads=d["num_heads"],
+        intermediate_size=d.get("intermediate_size"),
+        window_size=d.get("window_size", 256),
+        attention_layers=expand_attention_types(
+            d.get("attention_types") or [], d["num_layers"]
+        ),
+        layer_norm_epsilon=d.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def convert_gpt_neo_state_dict(
+    state_dict: Mapping[str, Any], config, dtype: str = "float32"
+) -> Dict[str, Any]:
+    """HF ``GPTNeoForCausalLM`` -> ``GPTNeoModel`` params.
+
+    GPT-Neo uses torch ``nn.Linear`` everywhere (kernels transpose, unlike
+    GPT-2's Conv1D); q/k/v are bias-free, ``out_proj`` and MLP carry biases;
+    the LM head is tied to ``wte``.
+    """
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    cast = lambda t: jnp.asarray(_np(t), dtype=jnp.dtype(dtype))
+    castT = lambda t: jnp.asarray(_np(t).T.copy(), dtype=jnp.dtype(dtype))
+
+    params: Dict[str, Any] = {
+        "wte": {"embedding": cast(sd["wte.weight"])},
+        "wpe": {"embedding": cast(sd["wpe.weight"])},
+        "ln_f": {"scale": cast(sd["ln_f.weight"]), "bias": cast(sd["ln_f.bias"])},
+    }
+    for i in range(config.num_layers):
+        p = f"h.{i}."
+        a = p + "attn.attention."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": cast(sd[p + "ln_1.weight"]), "bias": cast(sd[p + "ln_1.bias"])},
+            "ln_2": {"scale": cast(sd[p + "ln_2.weight"]), "bias": cast(sd[p + "ln_2.bias"])},
+            "attn": {
+                "q_proj": {"kernel": castT(sd[a + "q_proj.weight"])},
+                "k_proj": {"kernel": castT(sd[a + "k_proj.weight"])},
+                "v_proj": {"kernel": castT(sd[a + "v_proj.weight"])},
+                "out_proj": {
+                    "kernel": castT(sd[a + "out_proj.weight"]),
+                    "bias": cast(sd[a + "out_proj.bias"]),
+                },
+            },
+            "mlp": {
+                "c_fc": {
+                    "kernel": castT(sd[p + "mlp.c_fc.weight"]),
+                    "bias": cast(sd[p + "mlp.c_fc.bias"]),
+                },
+                "c_proj": {
+                    "kernel": castT(sd[p + "mlp.c_proj.weight"]),
+                    "bias": cast(sd[p + "mlp.c_proj.bias"]),
+                },
+            },
+        }
+    return params
+
+
+def load_gpt_neo_checkpoint(model_path: str, dtype: str = "float32"):
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(model_path, local_files_only=True)
+    config = gpt_neo_config_from_hf(model.config)
+    return config, convert_gpt_neo_state_dict(model.state_dict(), config, dtype)
+
+
 def load_gpt2_checkpoint(model_path: str, dtype: str = "float32"):
     """Load an on-disk HF GPT-2 checkpoint -> (GPT2Config, param tree).
 

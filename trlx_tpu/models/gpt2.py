@@ -28,12 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from trlx_tpu.ops.attention import (
-    causal_bias,
-    combine_biases,
-    dot_product_attention,
-    padding_bias,
-)
+from trlx_tpu.ops.attention import causal_dispatch, dot_product_attention
 
 # KV cache: tuple over layers of {"k": [B, C, H, Dh], "v": [B, C, H, Dh]}
 Cache = Tuple[Dict[str, jax.Array], ...]
@@ -93,6 +88,7 @@ class Attention(nn.Module):
         bias: Optional[jax.Array],
         cache_kv: Optional[Dict[str, jax.Array]] = None,
         cache_index: Optional[jax.Array] = None,
+        causal: bool = False,
     ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
@@ -115,7 +111,7 @@ class Attention(nn.Module):
             v = jax.lax.dynamic_update_slice(cache_kv["v"], v, (0, cache_index, 0, 0))
             new_kv = {"k": k, "v": v}
 
-        out = dot_product_attention(q, k, v, bias)
+        out = dot_product_attention(q, k, v, bias, causal=causal)
         out = out.reshape(B, T, cfg.n_embd)
         out = nn.Dense(cfg.n_embd, dtype=dtype, param_dtype=pdtype, name="c_proj")(out)
         return out, new_kv
@@ -125,12 +121,14 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, bias, cache_kv=None, cache_index=None):
+    def __call__(self, x, bias, cache_kv=None, cache_index=None, causal=False):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         eps = cfg.layer_norm_epsilon
         h = nn.LayerNorm(epsilon=eps, dtype=dtype, name="ln_1")(x)
-        attn_out, new_kv = Attention(cfg, name="attn")(h, bias, cache_kv, cache_index)
+        attn_out, new_kv = Attention(cfg, name="attn")(
+            h, bias, cache_kv, cache_index, causal
+        )
         x = x + attn_out
         h = nn.LayerNorm(epsilon=eps, dtype=dtype, name="ln_2")(x)
         x = x + MLP(cfg, name="mlp")(h)
@@ -204,17 +202,7 @@ class GPT2Model(nn.Module):
                     position_ids = jnp.arange(T)[None, :]
             x = self.embed(input_ids, position_ids)
 
-        # Additive attention bias
-        if cache is None:
-            kv_len = T
-            offset = 0
-        else:
-            kv_len = cache[0]["k"].shape[1]
-            offset = cache_index
-        bias = combine_biases(
-            causal_bias(T, kv_len, offset=offset if cache is not None else 0),
-            padding_bias(attention_mask) if attention_mask is not None else None,
-        )
+        bias, causal = causal_dispatch(T, cache, cache_index, attention_mask)
 
         new_cache: List = []
         branch_hidden = None
@@ -222,7 +210,7 @@ class GPT2Model(nn.Module):
             if capture_hidden_at is not None and i == capture_hidden_at:
                 branch_hidden = x
             layer_cache = cache[i] if cache is not None else None
-            x, new_kv = self.h[i](x, bias, layer_cache, cache_index)
+            x, new_kv = self.h[i](x, bias, layer_cache, cache_index, causal)
             new_cache.append(new_kv)
 
         x = self.ln_f(x)

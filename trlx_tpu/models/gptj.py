@@ -18,12 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from trlx_tpu.ops.attention import (
-    causal_bias,
-    combine_biases,
-    dot_product_attention,
-    padding_bias,
-)
+from trlx_tpu.ops.attention import causal_dispatch, dot_product_attention
 from trlx_tpu.ops.rotary import apply_rotary_interleaved, rotary_angles
 
 
@@ -59,7 +54,7 @@ class GPTJAttention(nn.Module):
     config: GPTJConfig
 
     @nn.compact
-    def __call__(self, x, bias, position_ids, cache_kv=None, cache_index=None):
+    def __call__(self, x, bias, position_ids, cache_kv=None, cache_index=None, causal=False):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         pdtype = jnp.dtype(cfg.param_dtype)
@@ -83,7 +78,7 @@ class GPTJAttention(nn.Module):
             v = jax.lax.dynamic_update_slice(cache_kv["v"], v, (0, cache_index, 0, 0))
             new_kv = {"k": k, "v": v}
 
-        out = dot_product_attention(q, k, v, bias)
+        out = dot_product_attention(q, k, v, bias, causal=causal)
         out = out.reshape(B, T, cfg.n_embd)
         return proj("out_proj")(out), new_kv
 
@@ -105,13 +100,13 @@ class GPTJBlock(nn.Module):
     config: GPTJConfig
 
     @nn.compact
-    def __call__(self, x, bias, position_ids, cache_kv=None, cache_index=None):
+    def __call__(self, x, bias, position_ids, cache_kv=None, cache_index=None, causal=False):
         cfg = self.config
         h = nn.LayerNorm(
             epsilon=cfg.layer_norm_epsilon, dtype=jnp.dtype(cfg.dtype), name="ln_1"
         )(x)
         attn_out, new_kv = GPTJAttention(cfg, name="attn")(
-            h, bias, position_ids, cache_kv, cache_index
+            h, bias, position_ids, cache_kv, cache_index, causal
         )
         mlp_out = GPTJMLP(cfg, name="mlp")(h)  # parallel residual branches
         return x + attn_out + mlp_out, new_kv
@@ -167,14 +162,7 @@ class GPTJModel(nn.Module):
         else:
             x = self.wte(input_ids).astype(jnp.dtype(cfg.dtype))
 
-        if cache is None:
-            kv_len, offset = T, 0
-        else:
-            kv_len, offset = cache[0]["k"].shape[1], cache_index
-        bias = combine_biases(
-            causal_bias(T, kv_len, offset=offset if cache is not None else 0),
-            padding_bias(attention_mask) if attention_mask is not None else None,
-        )
+        bias, causal = causal_dispatch(T, cache, cache_index, attention_mask)
 
         new_cache: List = []
         branch_hidden = None
@@ -182,7 +170,7 @@ class GPTJModel(nn.Module):
             if capture_hidden_at is not None and i == capture_hidden_at:
                 branch_hidden = x
             layer_cache = cache[i] if cache is not None else None
-            x, new_kv = self.h[i](x, bias, position_ids, layer_cache, cache_index)
+            x, new_kv = self.h[i](x, bias, position_ids, layer_cache, cache_index, causal)
             new_cache.append(new_kv)
 
         x = self.ln_f(x)

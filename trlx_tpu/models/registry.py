@@ -53,7 +53,9 @@ def hidden_size_of(config: Any) -> int:
 
 
 def num_layers_of(config: Any) -> int:
-    for attr in ("n_layer", "num_hidden_layers", "num_decoder_layers"):
+    # order matters: T5 has both num_layers (encoder) and num_decoder_layers —
+    # trainers freeze/branch on the decoder stack, so it takes precedence
+    for attr in ("n_layer", "num_hidden_layers", "num_decoder_layers", "num_layers"):
         if hasattr(config, attr):
             return getattr(config, attr)
     raise ValueError(f"no layer count on {type(config).__name__}")
@@ -67,6 +69,12 @@ def _register_builtins() -> None:
         GPTJModel,
         GPTJ_PARTITION_RULES,
         init_gptj_cache,
+    )
+    from trlx_tpu.models.gpt_neo import (
+        GPTNeoConfig,
+        GPTNeoModel,
+        GPT_NEO_PARTITION_RULES,
+        init_gpt_neo_cache,
     )
     from trlx_tpu.models.neox import (
         NeoXConfig,
@@ -88,6 +96,13 @@ def _register_builtins() -> None:
             conversion.load_gptj_checkpoint,
         ),
         "gpt-j",
+    )
+    register_model_family(
+        ModelFamily(
+            "gpt_neo", GPTNeoConfig, GPTNeoModel, GPT_NEO_PARTITION_RULES,
+            init_gpt_neo_cache, conversion.load_gpt_neo_checkpoint,
+        ),
+        "gpt-neo",
     )
     register_model_family(
         ModelFamily(

@@ -170,6 +170,7 @@ class T5Attention(nn.Module):
         cache_kv: Optional[Dict[str, jax.Array]] = None,
         cache_index: Optional[jax.Array] = None,
         static_kv: Optional[Tuple[jax.Array, jax.Array]] = None,  # precomputed cross k,v
+        learned_bias: bool = False,  # True when bias carries the rel-pos table
     ) -> Tuple[jax.Array, Optional[Dict[str, jax.Array]]]:
         cfg = self.config
         B, T, _ = x.shape
@@ -193,7 +194,7 @@ class T5Attention(nn.Module):
         # T5 attention is unscaled: pre-multiply q by sqrt(d_kv) to cancel
         # the 1/sqrt(d) inside the shared attention core.
         q = q * jnp.asarray(cfg.d_kv, q.dtype) ** 0.5
-        out = dot_product_attention(q, k, v, bias)
+        out = dot_product_attention(q, k, v, bias, learned_bias=learned_bias)
         out = out.reshape(B, T, inner)
         return self.o(out), new_kv
 
@@ -237,7 +238,9 @@ class T5EncoderBlock(nn.Module):
         ln = lambda name: T5LayerNorm(
             cfg.layer_norm_epsilon, cfg.dtype, cfg.param_dtype, name=name
         )
-        h, _ = T5Attention(cfg, name="SelfAttention")(ln("ln_self")(x), bias=bias)
+        h, _ = T5Attention(cfg, name="SelfAttention")(
+            ln("ln_self")(x), bias=bias, learned_bias=True
+        )
         x = x + h
         x = x + T5FF(cfg, name="DenseReluDense")(ln("ln_ff")(x))
         return x
@@ -268,7 +271,7 @@ class T5DecoderBlock(nn.Module):
     ):
         h, new_kv = self.SelfAttention(
             self.ln_self(x), bias=self_bias,
-            cache_kv=cache_kv, cache_index=cache_index,
+            cache_kv=cache_kv, cache_index=cache_index, learned_bias=True,
         )
         x = x + h
         h, _ = self.EncDecAttention(

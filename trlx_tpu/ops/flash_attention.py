@@ -1,0 +1,513 @@
+"""Pallas TPU flash attention (forward + custom-VJP backward).
+
+The reference leaves attention to torch/HF kernels; here the training/prefill
+hot op (SURVEY §2.9: "Pallas kernels only where XLA fusion is insufficient")
+is a blocked online-softmax kernel so the [B, H, Q, K] score matrix never
+round-trips HBM. The kernels use the canonical TPU structure: the key-tile
+loop is the innermost *grid* dimension (TPU grids run sequentially), with
+VMEM scratch accumulators persisting across those grid steps — initialized
+at the first key tile, emitted at the last — so Mosaic double-buffers the
+K/V tile DMAs against the MXU work and VMEM stays O(block² + block·D)
+regardless of sequence length. ``causal=True`` masks inside the kernel and
+predicates away fully-future tiles (half the MXU work) instead of
+materializing a [Q, K] causal bias in HBM.
+
+Backward recomputes scores per tile from the saved output/logsumexp (the
+standard flash recomputation) in two kernels: dQ (key tiles innermost) and
+dK/dV (query tiles innermost); ``delta = rowsum(dO · O)`` is folded into
+both rather than materialized.
+
+Numerics match :func:`trlx_tpu.ops.attention.dot_product_attention`: logits
+and softmax statistics in float32, the two MXU matmuls in the input dtype,
+finite ``NEG_INF`` masking (fully-masked rows degrade to uniform weights
+exactly like ``jax.nn.softmax`` over constant logits — under ``causal`` row
+0 always sees one key, so this arises only for all-padding rows).
+
+Bias support: any additive bias broadcastable to [B, H, Q, K]; size-1
+batch / head / query / key dims stay size-1 in VMEM — the BlockSpec index
+map pins them to block 0. The custom VJP returns a **zero** cotangent for
+the bias operand: route learned biases (T5 relative position bias) through
+the XLA path instead (``dot_product_attention(..., learned_bias=True)``).
+
+TPU layout notes: row statistics (logsumexp) carry a broadcast 128-lane
+trailing dim because Mosaic requires the last two dims of every block to be
+(8, 128)-aligned or span the whole array.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from trlx_tpu.ops.attention import NEG_INF
+
+BLOCK_Q = 512  # best on v5e across 1k-4k sequences (see tests/test_flash_attention.py)
+BLOCK_K = 512
+LANES = 128  # trailing broadcast dim for row statistics
+
+
+def _bias_spec(bias_shape, block_q, block_k, q_axis, k_axis):
+    """BlockSpec for a [b?, h?, Q?, K?] bias under a (B, H, t1, t2) grid.
+
+    ``q_axis``/``k_axis`` name which grid axis (2 or 3) tiles Q and K.
+    Size-1 bias dims stay size-1 (index pinned to 0) so broadcast biases
+    never materialize at full rank in VMEM.
+    """
+    b, h, q, k = bias_shape
+    block = (1, 1, block_q if q > 1 else 1, block_k if k > 1 else 1)
+
+    def index(bi, hi, t1, t2):
+        ts = {2: t1, 3: t2}
+        return (
+            bi if b > 1 else 0,
+            hi if h > 1 else 0,
+            ts[q_axis] if q > 1 else 0,
+            ts[k_axis] if k > 1 else 0,
+        )
+
+    return pl.BlockSpec(block, index, memory_space=pltpu.VMEM)
+
+
+def _read_bias(bias_ref):
+    """Load the (possibly size-1-broadcast) [q?, k?] bias block as f32."""
+    if bias_ref is None:
+        return None
+    return bias_ref[0, 0].astype(jnp.float32)
+
+
+def _causal_mask(q_lo, tq, k_lo, tk):
+    """[tq, tk] additive mask: query q_lo+i sees key k_lo+j iff j+k_lo <= i+q_lo."""
+    q_pos = q_lo + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    k_pos = k_lo + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    return jnp.where(k_pos <= q_pos, 0.0, NEG_INF).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(*refs, scale, block_q, block_k, has_bias, causal):
+    if has_bias:
+        q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+        bias_ref = None
+
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    q_lo = qi * block_q
+    k_lo = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, -jnp.inf)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    live = (k_lo <= q_lo + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0]  # [TQ, D]
+        k_blk = k_ref[0, 0]  # [TK, D]
+        v_blk = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [TQ, TK]
+        b = _read_bias(bias_ref)
+        if b is not None:
+            s = s + b
+        if causal:
+            s = s + _causal_mask(q_lo, block_q, k_lo, block_k)
+        m = m_s[:, 0:1]
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        alpha = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        l_s[:, 0:1] = l_s[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_s[:, 0:1] = new_m
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        m = m_s[:, 0:1]
+        l_safe = jnp.maximum(l_s[:, 0:1], 1e-30)
+        o_ref[0, 0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m + jnp.log(l_safe), (block_q, lse_ref.shape[-1])
+        )
+
+
+def _fwd(q, k, v, bias, *, scale, block_q, block_k, causal, interpret):
+    """q/k/v: [B, H, Qp, D] / [B, H, Kp, D]; returns (o, lse)."""
+    B, H, Qp, D = q.shape
+    Kp = k.shape[2]
+    grid = (B, H, Qp // block_q, Kp // block_k)
+
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias.shape, block_q, block_k, 2, 3))
+        args.append(bias)
+
+    out_specs = [
+        q_spec,
+        pl.BlockSpec(
+            (1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+    ]
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            has_bias=bias is not None, causal=causal,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Qp, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Qp, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, D), jnp.float32),      # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(*refs, scale, block_q, block_k, has_bias, causal):
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref, lse_ref, dq_ref,
+         dq_s) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_s = refs
+        bias_ref = None
+
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    q_lo = qi * block_q
+    k_lo = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    live = (k_lo <= q_lo + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0]
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0:1]  # [TQ, 1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [TQ, 1]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        b = _read_bias(bias_ref)
+        if b is not None:
+            s = s + b
+        if causal:
+            s = s + _causal_mask(q_lo, block_q, k_lo, block_k)
+        p = jnp.exp(s - lse)  # [TQ, TK]
+        dp = jax.lax.dot_general(
+            do, v_blk.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        dq_ref[0, 0] = (dq_s[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(*refs, scale, block_q, block_k, has_bias, causal):
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref, lse_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
+         dk_s, dv_s) = refs
+        bias_ref = None
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    n_q = pl.num_programs(3)
+    k_lo = ki * block_k
+    q_lo = qi * block_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    # skip q tiles whose last query is before the first key
+    live = (q_lo + block_q - 1 >= k_lo) if causal else True
+
+    @pl.when(live)
+    def _tile():
+        k_blk = k_ref[0, 0]  # [TK, D]
+        v32 = v_ref[0, 0].astype(jnp.float32)
+        q_blk = q_ref[0, 0]  # [TQ, D]
+        do = do_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0:1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [TQ, TK]
+        b = _read_bias(bias_ref)
+        if b is not None:
+            s = s + b
+        if causal:
+            s = s + _causal_mask(q_lo, block_q, k_lo, block_k)
+        p = jnp.exp(s - lse)
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v32, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)  # [TQ, TK]
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds, q_blk.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _emit():
+        dk_ref[0, 0] = (dk_s[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, bias, o, lse, do, *, scale, block_q, block_k, causal,
+         interpret):
+    B, H, Qp, D = q.shape
+    Kp = k.shape[2]
+    n_q, n_k = Qp // block_q, Kp // block_k
+
+    q_tile_qk = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_tile_qk = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0),
+        memory_space=pltpu.VMEM,
+    )
+    lse_tile_qk = pl.BlockSpec(
+        (1, 1, block_q, LANES), lambda b, h, qi, ki: (b, h, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+
+    # dQ: grid (B, H, nQ, nK) — K innermost, dq accumulates across it
+    in_specs = [q_tile_qk, kv_tile_qk, kv_tile_qk]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias.shape, block_q, block_k, 2, 3))
+        args.append(bias)
+    in_specs += [q_tile_qk, q_tile_qk, lse_tile_qk]
+    args += [do, o, lse]
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            has_bias=bias is not None, causal=causal,
+        ),
+        grid=(B, H, n_q, n_k),
+        in_specs=in_specs,
+        out_specs=q_tile_qk,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+
+    # dK/dV: grid (B, H, nK, nQ) — Q innermost, dk/dv accumulate across it
+    q_tile_kq = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    kv_tile_kq = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0),
+        memory_space=pltpu.VMEM,
+    )
+    lse_tile_kq = pl.BlockSpec(
+        (1, 1, block_q, LANES), lambda b, h, ki, qi: (b, h, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [q_tile_kq, kv_tile_kq, kv_tile_kq]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(_bias_spec(bias.shape, block_q, block_k, 3, 2))
+        args.append(bias)
+    in_specs += [q_tile_kq, q_tile_kq, lse_tile_kq]
+    args += [do, o, lse]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            has_bias=bias is not None, causal=causal,
+        ),
+        grid=(B, H, n_k, n_q),
+        in_specs=in_specs,
+        out_specs=[kv_tile_kq, kv_tile_kq],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper over padded [B, H, Q, D] layout
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, scale, block_q, block_k, causal, interpret):
+    o, _ = _fwd(
+        q, k, v, bias, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, interpret=interpret,
+    )
+    return o
+
+
+def _flash_fwd(q, k, v, bias, scale, block_q, block_k, causal, interpret):
+    o, lse = _fwd(
+        q, k, v, bias, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, interpret=interpret,
+    )
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_bwd(scale, block_q, block_k, causal, interpret, res, do):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv = _bwd(
+        q, k, v, bias, o, lse, do, scale=scale, block_q=block_q,
+        block_k=block_k, causal=causal, interpret=interpret,
+    )
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    rem = -size % multiple
+    if rem == 0:
+        return x, size
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad), size
+
+
+def flash_attention(
+    q: jax.Array,  # [B, Q, H, D]
+    k: jax.Array,  # [B, K, H, D]
+    v: jax.Array,  # [B, K, H, D]
+    bias: Optional[jax.Array] = None,  # broadcastable to [B, H, Q, K]
+    causal: bool = False,
+    block_q: int = BLOCK_Q,
+    block_k: int = BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over the framework's [B, T, H, D] layout.
+
+    Pads Q/K to tile multiples (padded keys masked via bias, padded query
+    rows dropped), transposes to [B, H, T, D] for lane-aligned tiles, and
+    dispatches the custom-VJP pallas kernels. ``causal=True`` masks in-kernel
+    and skips future key tiles — pass it instead of a causal bias. Gradient
+    does NOT flow to ``bias`` (see module docstring).
+
+    ``causal`` assumes query position i is absolute position i (offset 0) —
+    the training / prefill case. For cache decode at an offset, pass an
+    explicit bias.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, Q, H, D = q.shape
+    K = k.shape[1]
+    scale = float(1.0 / (D ** 0.5))
+
+    block_q = min(block_q, max(8, -(-Q // 8) * 8))  # small-Q: shrink tile
+    block_k = min(block_k, max(8, -(-K // 8) * 8))
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, Q, D]
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    qt, _ = _pad_to(qt, 2, block_q)
+    kt, _ = _pad_to(kt, 2, block_k)
+    vt, _ = _pad_to(vt, 2, block_k)
+    Kp = kt.shape[2]
+
+    if bias is not None:
+        if bias.ndim != 4:
+            raise ValueError(f"bias must be rank-4, got {bias.shape}")
+        bias = bias.astype(jnp.float32)
+        if bias.shape[3] > 1:
+            bias, _ = _pad_to(bias, 3, block_k)  # zeros; masked next
+        if bias.shape[2] > 1:
+            bias, _ = _pad_to(bias, 2, block_q)
+    if Kp != K:
+        # mask padded keys for every query row (broadcasts over size-1 dims)
+        pad_bias = jnp.where(
+            jnp.arange(Kp)[None, None, None, :] < K, 0.0, NEG_INF
+        ).astype(jnp.float32)
+        bias = pad_bias if bias is None else bias + pad_bias
+
+    out = _flash(qt, kt, vt, bias, scale, block_q, block_k, causal, interpret)
+    out = out[:, :, :Q, :]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

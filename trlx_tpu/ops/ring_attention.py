@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-NEG_INF = -1e9
+from trlx_tpu.ops.attention import NEG_INF
 
 
 def ring_attention(

@@ -15,6 +15,8 @@ from typing import Any, Optional
 
 import jax
 
+_initialized = False
+
 
 def initialize(
     coordinator_address: Optional[str] = None,
@@ -23,19 +25,22 @@ def initialize(
 ) -> None:
     """Initialize the multi-host runtime (no-op single-process).
 
-    On TPU pods, all arguments are auto-detected from the TPU metadata; on
-    other platforms provide them explicitly or via
-    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``.
+    Must run before anything touches the XLA backend —
+    ``jax.distributed.initialize`` rejects a process whose backend is
+    already live, so the multi-host probe here uses *environment only*
+    (``TPU_WORKER_HOSTNAMES`` on pods; ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` elsewhere), never
+    ``jax.devices()``/``jax.process_count()``. On TPU pods all arguments
+    are auto-detected from the TPU metadata.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    global _initialized
+    if _initialized:
+        return
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )
     explicit = coordinator_address is not None
-    on_tpu_pod = any(d.platform == "tpu" for d in jax.local_devices()) and (
-        os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") > 0
-    )
+    on_tpu_pod = os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") > 0
     if explicit or on_tpu_pod:
         kwargs = {}
         if explicit:
@@ -46,6 +51,7 @@ def initialize(
                 process_id=process_id or int(os.environ.get("JAX_PROCESS_ID", 0)),
             )
         jax.distributed.initialize(**kwargs)
+        _initialized = True
 
 
 def barrier(name: str = "sync") -> None:
