@@ -87,21 +87,17 @@ def main():
         trainer, pipeline, reward_fn=reward_fn, chunk_size=config.method.chunk_size
     )
 
-    from trlx_tpu.parallel.mesh import batch_sharding
-
     def one_phase():
         trainer.buffer.clear_history()
         orch.make_experience(config.method.num_rollouts, 0)
-        for mb in trainer.buffer.create_loader(
-            config.train.batch_size, sharding=batch_sharding(trainer.mesh)
-        ):
-            for _ in range(config.method.ppo_epochs):
-                trainer.state, _ = trainer._train_step_jit(trainer.state, mb)
+        # one fused dispatch for all minibatch x ppo_epoch updates
+        trainer.train_on_buffer()
         import jax
 
         jax.block_until_ready(trainer.state.params)
 
-    one_phase()  # warmup: compile sampler + train step
+    one_phase()  # warmup: compile sampler + fused train phase
+    one_phase()  # second warmup: absorbs any donated-buffer relayout retrace
 
     n_phases = 3
     start = time.time()

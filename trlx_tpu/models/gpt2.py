@@ -178,8 +178,14 @@ class GPT2Model(nn.Module):
         start_layer: int = 0,
         hidden_override: Optional[jax.Array] = None,
         capture_hidden_at: Optional[int] = None,
+        compute_logits: bool = True,
     ):
         """Returns ``{"logits", "hidden", "cache"[, "branch_hidden"]}``.
+
+        ``compute_logits=False`` skips the LM head (callers that only need a
+        slice of positions apply :meth:`logits` to sliced hidden — the full
+        [B, T, vocab] float32 tensor is the single most expensive
+        intermediate in the PPO update).
 
         The hydra frozen-branch mechanism (`ppo_models.py:505-558`):
         ``capture_hidden_at=k`` additionally returns the activation entering
@@ -214,9 +220,8 @@ class GPT2Model(nn.Module):
             new_cache.append(new_kv)
 
         x = self.ln_f(x)
-        logits = self.logits(x)
         out = {
-            "logits": logits,
+            "logits": self.logits(x) if compute_logits else None,
             "hidden": x,
             "cache": tuple(new_cache) if cache is not None else None,
         }

@@ -224,6 +224,7 @@ class GPTNeoModel(nn.Module):
         start_layer: int = 0,
         hidden_override: Optional[jax.Array] = None,
         capture_hidden_at: Optional[int] = None,
+        compute_logits: bool = True,
     ):
         cfg = self.config
         T = input_ids.shape[1] if hidden_override is None else hidden_override.shape[1]
@@ -270,7 +271,7 @@ class GPTNeoModel(nn.Module):
 
         x = self.ln_f(x)
         out = {
-            "logits": self.logits(x),
+            "logits": self.logits(x) if compute_logits else None,
             "hidden": x,
             "cache": tuple(new_cache) if cache is not None else None,
         }

@@ -133,6 +133,10 @@ class GPTJModel(nn.Module):
             name="lm_head",
         )
 
+    def logits(self, hidden: jax.Array) -> jax.Array:
+        """LM head on (already ln_f-normalized) hidden states; float32."""
+        return self.lm_head(hidden).astype(jnp.float32)
+
     def __call__(
         self,
         input_ids: jax.Array,
@@ -143,6 +147,7 @@ class GPTJModel(nn.Module):
         start_layer: int = 0,
         hidden_override: Optional[jax.Array] = None,
         capture_hidden_at: Optional[int] = None,
+        compute_logits: bool = True,
     ):
         cfg = self.config
         T = input_ids.shape[1] if hidden_override is None else hidden_override.shape[1]
@@ -174,9 +179,8 @@ class GPTJModel(nn.Module):
             new_cache.append(new_kv)
 
         x = self.ln_f(x)
-        logits = self.lm_head(x).astype(jnp.float32)
         out = {
-            "logits": logits,
+            "logits": self.logits(x) if compute_logits else None,
             "hidden": x,
             "cache": tuple(new_cache) if cache is not None else None,
         }

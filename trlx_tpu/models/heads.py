@@ -75,16 +75,46 @@ class CausalLMWithValueHead(nn.Module):
         position_ids: Optional[jax.Array] = None,
         cache=None,
         cache_index=None,
+        last_only: bool = False,
     ):
+        """``last_only=True`` computes logits/values only for the final
+        position (sampler prefill: the [B, Q, vocab] float32 logits tensor
+        for the whole prompt would be written to HBM just to read one row).
+        """
         out = self.backbone(
             input_ids,
             attention_mask=attention_mask,
             position_ids=position_ids,
             cache=cache,
             cache_index=cache_index,
+            compute_logits=not last_only,
         )
-        out["values"] = self.v_head(out["hidden"])[..., 0]
+        if last_only:
+            h = out["hidden"][:, -1:]
+            out["logits"] = self.backbone.logits(h)
+            out["values"] = self.v_head(h)[..., 0]
+        else:
+            out["values"] = self.v_head(out["hidden"])[..., 0]
         return out
+
+    def response_forward(
+        self,
+        input_ids: jax.Array,
+        attention_mask: jax.Array,
+        query_length: int,
+    ):
+        """(logits, values) over response-predicting positions only.
+
+        The PPO update needs logits/values at positions Q-1..Q+R-2 (the
+        states that predict each response token); computing the LM head for
+        the query positions too would write (and backprop through) a
+        [B, Q+R, vocab] float32 tensor for nothing.
+        """
+        out = self.backbone(
+            input_ids, attention_mask=attention_mask, compute_logits=False
+        )
+        h = out["hidden"][:, query_length - 1 : -1]
+        return self.backbone.logits(h), self.v_head(h)[..., 0]
 
     def lm_only(
         self,

@@ -168,12 +168,17 @@ def ppo_loss(
 
 
 def adaptive_kl_update(
-    kl_coef: jax.Array, current_kl: jax.Array, n_steps: int, target: float, horizon: int
-) -> jax.Array:
-    """Ziegler et al. proportional controller (`ppo_models.py:37-44`)."""
-    proportional_error = jnp.clip(current_kl / target - 1.0, -0.2, 0.2)
-    mult = 1.0 + proportional_error * n_steps / horizon
-    return kl_coef * mult
+    kl_coef, current_kl, n_steps: int, target: float, horizon: int
+):
+    """Ziegler et al. proportional controller (`ppo_models.py:37-44`).
+
+    Works on tracers (inside jit) and plain floats (the host training loop
+    calls it once per minibatch — python math there, no device dispatch)."""
+    if isinstance(kl_coef, jax.Array) or isinstance(current_kl, jax.Array):
+        err = jnp.clip(current_kl / target - 1.0, -0.2, 0.2)
+    else:
+        err = min(max(current_kl / target - 1.0, -0.2), 0.2)
+    return kl_coef * (1.0 + err * n_steps / horizon)
 
 
 def kl_controller_update(

@@ -101,6 +101,15 @@ def make_sampler(
     Q = query_length
     R = gen_config.max_new_tokens
     cap = Q + R
+    # Optional fast-prefill contract: an apply_fn accepting ``last_only``
+    # may skip LM-head/value computation for all but the final position.
+    import inspect
+
+    _prefill_kwargs = (
+        {"last_only": True}
+        if "last_only" in inspect.signature(apply_fn).parameters
+        else {}
+    )
 
     def sampler(params, prompt_ids, prompt_mask, rng) -> SampleOutput:
         B = prompt_ids.shape[0]
@@ -118,6 +127,7 @@ def make_sampler(
             position_ids=positions,
             cache=cache,
             cache_index=0,
+            **_prefill_kwargs,
         )
         cache = out["cache"]
         logits_last = out["logits"][:, -1].astype(jnp.float32)  # [B, V]

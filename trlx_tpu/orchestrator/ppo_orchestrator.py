@@ -86,6 +86,17 @@ class PPOOrchestrator(Orchestrator):
             sample_out = self.trainer.sample(batch.input_ids, batch.attention_mask)
             generate_time += t.tick() / 1000.0
 
+            # Dispatch the frozen-reference forward *before* the host-side
+            # detokenize + reward call: the device computes ref logprobs
+            # while Python scores the batch (SURVEY §7.3 — "call out +
+            # re-insert scores without stalling the TPU").
+            ref_logprobs = self.trainer.score_ref(
+                batch.input_ids,
+                batch.attention_mask,
+                sample_out.tokens,
+                sample_out.response_mask,
+            )
+
             texts = self.trainer.decode_responses(
                 sample_out.tokens, sample_out.response_mask
             )
@@ -115,12 +126,6 @@ class PPOOrchestrator(Orchestrator):
                     scores, -method.cliprange_reward, method.cliprange_reward
                 )
 
-            ref_logprobs = self.trainer.score_ref(
-                batch.input_ids,
-                batch.attention_mask,
-                sample_out.tokens,
-                sample_out.response_mask,
-            )
             rewards = self.trainer.compute_rewards(
                 sample_out.logprobs,
                 ref_logprobs,

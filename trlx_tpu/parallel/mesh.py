@@ -81,6 +81,13 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(BATCH_AXES))
 
 
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [n_mb, B, ...] stacked-minibatch arrays: the scan axis is
+    replicated, the batch axis splits over dp x fsdp (each scan slice then
+    matches :func:`batch_sharding`)."""
+    return NamedSharding(mesh, P(None, BATCH_AXES))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -76,3 +76,39 @@ class PPORolloutBuffer(BaseRolloutStore):
             if sharding is not None:
                 mb = jax.device_put(mb, sharding)
             yield mb
+
+    def stacked_minibatches(
+        self,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        sharding=None,
+        repeat: int = 1,
+    ) -> PPORolloutBatch:
+        """All minibatches of one buffer pass as a single [n_mb*repeat, B,
+        ...] pytree — the input of the fused (one-dispatch) train phase,
+        scanned on device instead of dispatched per minibatch.
+
+        ``repeat`` duplicates each minibatch consecutively (PPO's
+        ``ppo_epochs`` inner updates on the same minibatch), which keeps the
+        fused phase a flat scan of one train-step body — far cheaper to
+        compile than a nested/unrolled loop. ``sharding`` should be the
+        mesh's ``stacked_batch_sharding`` so each scan slice lands with the
+        train step's expected batch sharding.
+        """
+        full = self.full
+        n = full.batch_size
+        n_mb = n // batch_size
+        if n_mb == 0:
+            raise ValueError(f"buffer smaller than one minibatch ({n} < {batch_size})")
+        order = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+        idx = order[: n_mb * batch_size].reshape(n_mb, 1, batch_size)
+        idx = np.broadcast_to(idx, (n_mb, repeat, batch_size)).reshape(
+            n_mb * repeat, batch_size
+        )
+        mbs = full.select(jnp.asarray(idx))  # leaves gain a leading dim
+        if sharding is not None:
+            mbs = jax.device_put(mbs, sharding)
+        return mbs

@@ -71,6 +71,19 @@ class PromptPipeline(BasePipeline):
         self.input_ids, self.attention_mask = left_pad(
             token_lists, max_prompt_length, pad_id
         )
+        # Pre-decode token-list prompts once (from the padded/truncated
+        # arrays, so the text matches what the model sees) — the rollout
+        # loop otherwise re-detokenizes every chunk, stalling the device.
+        for i, text in enumerate(self.prompts_text):
+            if text is None:
+                ids = self.input_ids[i][self.attention_mask[i] > 0]
+                if tokenizer is not None:
+                    # match trainer.decode_queries exactly
+                    self.prompts_text[i] = tokenizer.decode(
+                        ids, skip_special_tokens=True
+                    )
+                else:
+                    self.prompts_text[i] = " ".join(map(str, ids.tolist()))
         self.response_gt = list(response_gt) if response_gt is not None else None
 
     def __len__(self) -> int:

@@ -215,7 +215,10 @@ class ILQLTrainer(BaseRLTrainer):
 
         # --- advantage-shifted sampler (`ilql_models.py:257-327`) ---
         def sample_apply(bundle, input_ids, attention_mask=None, position_ids=None,
-                         cache=None, cache_index=None):
+                         cache=None, cache_index=None, last_only=False):
+            # last_only (prefill) is accepted but not specialized: the
+            # advantage shift needs per-position Q/V heads anyway; the
+            # sampler only reads the final position either way.
             out = self.model.apply(
                 {"params": bundle["params"]},
                 input_ids,

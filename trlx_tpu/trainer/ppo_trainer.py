@@ -228,7 +228,7 @@ class PPOTrainer(BaseRLTrainer):
         """Jittable (params, prompt_ids, prompt_mask, rng) -> SampleOutput."""
 
         def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
-                     cache=None, cache_index=None):
+                     cache=None, cache_index=None, last_only=False):
             return self.model.apply(
                 {"params": params},
                 input_ids,
@@ -236,6 +236,7 @@ class PPOTrainer(BaseRLTrainer):
                 position_ids=position_ids,
                 cache=cache,
                 cache_index=cache_index,
+                last_only=last_only,
             )
 
         return make_sampler(
@@ -249,16 +250,18 @@ class PPOTrainer(BaseRLTrainer):
     def _forward_logprobs_values(self, params, mb: PPORolloutBatch):
         """Policy forward -> (logprobs, values) over response positions.
 
-        Causal LM: forward [query; response], slice positions Q-1..Q+R-2
-        (the states that *predict* each response token)."""
+        Causal LM: forward [query; response]; hidden states are sliced to
+        positions Q-1..Q+R-2 (the states that *predict* each response token)
+        *before* the LM/value heads run (``response_forward``)."""
         Q = self.query_length
         full_ids = jnp.concatenate([mb.query_tokens, mb.response_tokens], axis=1)
         full_mask = jnp.concatenate([mb.query_mask, mb.response_mask], axis=1)
-        out = self.model.apply({"params": params}, full_ids, attention_mask=full_mask)
-        logits = out["logits"][:, Q - 1 : -1]
-        values = out["values"][:, Q - 1 : -1].astype(jnp.float32)
+        logits, values = self.model.apply(
+            {"params": params}, full_ids, full_mask, Q,
+            method=self.model.response_forward,
+        )
         logprobs = logprobs_from_logits(logits, mb.response_tokens)
-        return logprobs, values
+        return logprobs, values.astype(jnp.float32)
 
     def _supports_hydra(self) -> bool:
         return True
@@ -279,6 +282,7 @@ class PPOTrainer(BaseRLTrainer):
                 full_ids,
                 attention_mask=full_mask,
                 capture_hidden_at=self.branch_start,
+                compute_logits=False,  # only the captured hidden is used
             )
             out = self.backbone.apply(
                 {"params": ref_params},
@@ -286,12 +290,18 @@ class PPOTrainer(BaseRLTrainer):
                 attention_mask=full_mask,
                 start_layer=self.branch_start,
                 hidden_override=trunk_out["branch_hidden"],
+                compute_logits=False,
             )
         else:
             out = self.backbone.apply(
-                {"params": ref_params}, full_ids, attention_mask=full_mask
+                {"params": ref_params}, full_ids, attention_mask=full_mask,
+                compute_logits=False,
             )
-        logits = out["logits"][:, Q - 1 : -1]
+        # LM head only on response-predicting positions
+        logits = self.backbone.apply(
+            {"params": ref_params}, out["hidden"][:, Q - 1 : -1],
+            method=self.backbone.logits,
+        )
         return logprobs_from_logits(logits, r_ids)
 
     # ------------------------------------------------------------------ #
@@ -382,6 +392,23 @@ class PPOTrainer(BaseRLTrainer):
             donate_argnums=(0,),
         )
 
+        def train_phase(state: TrainState, mbs: PPORolloutBatch):
+            """One full buffer pass in a single dispatch: flat scan over
+            [n_mb * ppo_epochs] pre-repeated minibatch slices (the reference
+            inner loop, `accelerate_base_model.py:253-266`, realized as
+            consecutive identical slices) — one train-step body to compile."""
+            return jax.lax.scan(train_step, state, mbs)
+
+        from trlx_tpu.parallel.mesh import stacked_batch_sharding
+
+        self._stacked_batch_sh = stacked_batch_sharding(self.mesh)
+        self._train_phase_jit = jax.jit(
+            train_phase,
+            in_shardings=(self.state_shardings, self._stacked_batch_sh),
+            out_shardings=(self.state_shardings, rep),
+            donate_argnums=(0,),
+        )
+
     # ------------------------------------------------------------------ #
 
     def sample(self, prompt_ids, prompt_mask) -> SampleOutput:
@@ -404,6 +431,37 @@ class PPOTrainer(BaseRLTrainer):
         )
         self.mean_kl = float(mean_kl)
         return rewards
+
+    def train_on_buffer(self, seed: int = 0) -> Tuple[int, Dict[str, Any]]:
+        """One fused buffer pass: every minibatch x ``ppo_epochs`` update in a
+        single device dispatch (vs one dispatch per update). Returns
+        ``(n_steps_taken, stacked_stats, kl_seq)``: each stats leaf has a
+        leading [n_minibatches * ppo_epochs] dim (one row per update in
+        execution order); ``kl_seq[k]`` is the KL coefficient after
+        minibatch k (``kl_seq[0]`` = value on entry).
+
+        The adaptive KL coefficient is advanced once per minibatch with the
+        same compounding as the stepwise path (`accelerate_ppo_model.py:
+        136-137`) — it only feeds the *next* experience collection, so
+        updating it after the fused pass is exact.
+        """
+        train = self.config.train
+        method: PPOConfig = self.config.method
+        mbs = self.buffer.stacked_minibatches(
+            train.batch_size, shuffle=True, seed=seed,
+            sharding=self._stacked_batch_sh, repeat=method.ppo_epochs,
+        )
+        n_mb = len(self.buffer) // train.batch_size
+        self.state, stats = self._train_phase_jit(self.state, mbs)
+        kl_seq = [self.kl_coef]
+        for _ in range(n_mb):
+            kl_seq.append(
+                kl_controller_update(
+                    method, kl_seq[-1], self.mean_kl, train.batch_size
+                )
+            )
+        self.kl_coef = kl_seq[-1]
+        return n_mb * method.ppo_epochs, stats, kl_seq
 
     def learn(self) -> Dict[str, Any]:
         """PPO optimization loop (reference `accelerate_base_model.py:224-305`
@@ -441,6 +499,61 @@ class PPOTrainer(BaseRLTrainer):
             jax.profiler.start_trace(train.profile_dir)
             profiling = True
         for epoch in range(train.epochs):
+            # Fused path: the whole buffer pass is one device dispatch
+            # (lax.scan over minibatches) — used whenever no eval/save
+            # boundary or total_steps cutoff falls strictly inside the pass
+            # (log cadence is honored post-hoc from the stacked stats).
+            pass_steps = method.ppo_epochs * n_minibatches
+            interior = [
+                iter_count + method.ppo_epochs * k
+                for k in range(1, n_minibatches)
+            ]
+            fused_ok = (
+                not profiling
+                and len(self.buffer) >= train.batch_size
+                and iter_count + pass_steps <= total_steps
+                and not any(
+                    s % train.eval_interval == 0
+                    or (s > 0 and s % train.checkpoint_interval == 0)
+                    for s in interior
+                )
+            )
+            if fused_ok:
+                _, stacked, kl_seq = self.train_on_buffer(seed=train.seed + epoch)
+                phase_time = clock.tick(train.batch_size) / 1000.0
+                rows = {k: np.asarray(v) for k, v in stacked.items()}
+                step_stats = {}
+                for k in range(n_minibatches):
+                    iter_count += method.ppo_epochs
+                    # the stepwise loop logs the last inner update per mb
+                    row = k * method.ppo_epochs + method.ppo_epochs - 1
+                    step_stats = {key: float(v[row]) for key, v in rows.items()}
+                    step_stats["time/batch"] = phase_time / n_minibatches
+                    step_stats["policy/kl_coef"] = kl_seq[k + 1]
+                    step_stats["policy/mean_rollout_kl"] = self.mean_kl
+                    if iter_count % train.log_interval == 0:
+                        logger.log(step_stats, step=iter_count)
+                        final_stats = dict(step_stats)
+                iv = self.intervals(iter_count)
+                if iv["do_eval"]:
+                    eval_stats = self.evaluate()
+                    logger.log(eval_stats, step=iter_count)
+                    final_stats.update(eval_stats)
+                if iv["do_save"]:
+                    self.save()
+                if iter_count >= total_steps:
+                    self.save()
+                    eval_stats = self.evaluate()
+                    logger.log(eval_stats, step=iter_count)
+                    final_stats.update(eval_stats)
+                    logger.finish()
+                    self._final_stats = final_stats
+                    return final_stats
+                if self.orch is not None and epoch < train.epochs - 1:
+                    self.buffer.clear_history()
+                    self.orch.make_experience(method.num_rollouts, iter_count)
+                continue
+
             for mb in self.buffer.create_loader(
                 train.batch_size,
                 shuffle=True,
