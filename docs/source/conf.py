@@ -1,0 +1,20 @@
+"""Sphinx configuration for trlx_tpu docs."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "trlx_tpu"
+author = "trlx_tpu contributors"
+release = "0.1.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+autodoc_mock_imports = ["jax", "flax", "optax", "orbax", "transformers", "torch"]
+html_theme = "sphinx_rtd_theme"
+exclude_patterns = []

@@ -1,0 +1,100 @@
+"""Build ``samples.tsv`` prompt / ground-truth pairs for seq2seq PPO.
+
+Script equivalent of the fork's ``data_process.ipynb`` (SURVEY §2.8: quote
+extraction from novels -> UL2 ``<extra_id_0>`` infill pairs, consumed by
+``trlx.train`` via the hard-coded tsv at `trlx/trlx.py:46-54`; here the tsv
+feeds ``examples/rl_ul2.py`` through the explicit ``prompts``/``response_gt``
+pipeline arguments).
+
+Given a plain-text corpus, each quoted utterance becomes one training pair:
+
+- prompt: the paragraph with the quote replaced by the sentinel
+  ``<extra_id_0>`` (the UL2/T5 infilling task format), truncated to fit;
+- response_gt: the quote itself followed by ``<extra_id_1>`` (the fork's
+  truncation marker, `ul2_RL/rl_ul2.py:52-68`).
+
+Usage::
+
+    python examples/data_process.py corpus.txt samples.tsv \
+        --min-quote-chars 4 --max-context-chars 400
+
+Quote characters cover both CJK （「」『』“”） and ASCII ("...") styles, as
+the fork targets Chinese dialogue.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+from typing import Iterable, List, Tuple
+
+# paired quote delimiters, CJK first (the fork's corpus is Chinese novels)
+QUOTE_PAIRS = [
+    ("“", "”"),  # “ ”
+    ("「", "」"),  # 「 」
+    ("『", "』"),  # 『 』
+    ('"', '"'),
+]
+
+SENTINEL = "<extra_id_0>"
+END_MARK = "<extra_id_1>"
+
+
+def extract_pairs(
+    paragraphs: Iterable[str],
+    min_quote_chars: int = 4,
+    max_context_chars: int = 400,
+) -> List[Tuple[str, str]]:
+    """(masked paragraph, quote) pairs — one per quoted utterance."""
+    pairs: List[Tuple[str, str]] = []
+    for para in paragraphs:
+        para = para.strip()
+        if not para:
+            continue
+        for open_q, close_q in QUOTE_PAIRS:
+            pattern = re.escape(open_q) + r"([^" + re.escape(close_q) + r"]+)" + re.escape(close_q)
+            for m in re.finditer(pattern, para):
+                quote = m.group(1).strip()
+                if len(quote) < min_quote_chars:
+                    continue
+                masked = para[: m.start(1)] + SENTINEL + para[m.end(1):]
+                if len(masked) > max_context_chars:
+                    # center the sentinel in the retained window
+                    pos = masked.index(SENTINEL)
+                    half = max_context_chars // 2
+                    start = max(0, pos - half)
+                    masked = masked[start : start + max_context_chars]
+                    if SENTINEL not in masked:
+                        continue
+                pairs.append((masked, quote + END_MARK))
+    return pairs
+
+
+def write_tsv(pairs: List[Tuple[str, str]], path: str) -> None:
+    """Two-column tsv (prompt \\t response_gt), the format the fork's
+    ``trlx.train`` reads (`trlx/trlx.py:46-54`)."""
+    with open(path, "w", encoding="utf-8") as f:
+        for prompt, gt in pairs:
+            prompt = prompt.replace("\t", " ").replace("\n", " ")
+            gt = gt.replace("\t", " ").replace("\n", " ")
+            f.write(f"{prompt}\t{gt}\n")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("corpus", help="plain-text corpus (one paragraph per line)")
+    ap.add_argument("output", help="output samples.tsv path")
+    ap.add_argument("--min-quote-chars", type=int, default=4)
+    ap.add_argument("--max-context-chars", type=int, default=400)
+    args = ap.parse_args()
+
+    with open(args.corpus, encoding="utf-8") as f:
+        pairs = extract_pairs(
+            f, args.min_quote_chars, args.max_context_chars
+        )
+    write_tsv(pairs, args.output)
+    print(f"wrote {len(pairs)} pairs to {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -111,7 +111,7 @@ def make_task(
     )
 
 
-def main():
+def main(overrides: dict | None = None):
     import trlx_tpu
 
     config = TRLConfig.load_yaml(
@@ -121,14 +121,17 @@ def main():
             "ppo_randomwalks.yml",
         )
     )
+    if overrides:
+        config.update(**overrides)
     reward_fn, metric_fn, prompts, _, _ = make_task()
-    trlx_tpu.train(
+    trainer = trlx_tpu.train(
         reward_fn=reward_fn,
         metric_fn=metric_fn,
         prompts=prompts,
         eval_prompts=prompts,
         config=config,
     )
+    return getattr(trainer, "_final_stats", None)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,100 @@
+"""Auxiliary-component coverage: sweep search-alg/scheduler dispatch,
+sentiment_score, the samples.tsv data-prep script, and tune-ready train
+funcs (SURVEY §2.6-2.8 inventory items)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class TestSweepDispatch:
+    def test_random_and_fifo_are_none(self):
+        from trlx_tpu.sweep import get_scheduler, get_search_alg
+
+        tc = {"mode": "max", "metric": "reward/mean", "search_alg": "random",
+              "scheduler": "fifo"}
+        assert get_search_alg(tc) is None
+        assert get_scheduler(tc) is None
+        assert get_search_alg({"mode": "max", "metric": "m"}) is None
+        assert get_scheduler({}) is None
+
+    def test_unknown_names_raise(self):
+        from trlx_tpu.sweep import get_scheduler, get_search_alg
+
+        with pytest.raises(ValueError, match="search_alg"):
+            get_search_alg({"mode": "max", "metric": "m", "search_alg": "nope"})
+        with pytest.raises(ValueError, match="scheduler"):
+            get_scheduler({"scheduler": "nope"})
+
+    def test_bayes_algs_require_ray(self):
+        from trlx_tpu.sweep import get_search_alg
+
+        pytest.importorskip("ray.tune.search.bayesopt")
+        alg = get_search_alg(
+            {"mode": "max", "metric": "m", "search_alg": "bayesopt"}
+        )
+        assert alg is not None
+
+
+def test_sentiment_score():
+    from trlx_tpu.utils import sentiment_score
+
+    outs = [
+        [{"label": "NEGATIVE", "score": 0.1}, {"label": "POSITIVE", "score": 0.9}],
+        [{"label": "NEGATIVE", "score": 0.7}, {"label": "POSITIVE", "score": 0.3}],
+    ]
+    scores = np.asarray(sentiment_score(outs))
+    np.testing.assert_allclose(scores, [0.9, 0.3], atol=1e-6)
+
+    # generic heads arrive score-sorted (HF pipeline top_k ordering) — the
+    # positive class must be picked by label, not by position
+    generic = [
+        [{"label": "LABEL_1", "score": 0.95}, {"label": "LABEL_0", "score": 0.05}],
+        [{"label": "LABEL_0", "score": 0.97}, {"label": "LABEL_1", "score": 0.03}],
+    ]
+    scores = np.asarray(sentiment_score(generic))
+    np.testing.assert_allclose(scores, [0.95, 0.03], atol=1e-6)
+
+
+class TestDataProcess:
+    def test_extract_and_write(self, tmp_path):
+        from examples.data_process import END_MARK, SENTINEL, extract_pairs, write_tsv
+
+        paragraphs = [
+            '他说：“今天天气真好，我们出去走走吧。”然后起身。',
+            'She replied, "Absolutely not going anywhere today." and left.',
+            "no quotes here",
+            '短引号“嗯”太短了。',  # quote below min length -> dropped
+        ]
+        pairs = extract_pairs(paragraphs, min_quote_chars=4)
+        assert len(pairs) == 2
+        for masked, gt in pairs:
+            assert SENTINEL in masked
+            assert gt.endswith(END_MARK)
+        assert pairs[0][1] == "今天天气真好，我们出去走走吧。" + END_MARK
+
+        out = tmp_path / "samples.tsv"
+        write_tsv(pairs, str(out))
+        lines = out.read_text(encoding="utf-8").strip().split("\n")
+        assert len(lines) == 2
+        assert all(len(line.split("\t")) == 2 for line in lines)
+
+    def test_long_context_window_keeps_sentinel(self):
+        from examples.data_process import SENTINEL, extract_pairs
+
+        para = "x" * 500 + '“这是一个被掩蔽的引用句子。”' + "y" * 500
+        pairs = extract_pairs([para], max_context_chars=200)
+        assert len(pairs) == 1
+        assert SENTINEL in pairs[0][0]
+        assert len(pairs[0][0]) <= 200
+
+
+def test_train_funcs_importable():
+    from trlx_tpu.sweep import train_funcs
+
+    assert callable(train_funcs.ppo_randomwalks_train)
+    assert callable(train_funcs.ppo_sentiments_train)

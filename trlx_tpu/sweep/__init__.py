@@ -185,6 +185,38 @@ def run_local_sweep(
     return best, trials
 
 
+def get_search_alg(tune_config: Dict[str, Any]):
+    """Search algorithm by name (`ray_tune/__init__.py:90-124`):
+    ``bayesopt`` / ``bohb`` / ``random`` (None). Raises if the named
+    algorithm's optional dependency is missing, as the reference does."""
+    name = (tune_config.get("search_alg") or "random").lower()
+    if name in ("random", "", "none"):
+        return None
+    mode, metric = tune_config["mode"], tune_config["metric"]
+    if name == "bayesopt":
+        from ray.tune.search.bayesopt import BayesOptSearch
+
+        return BayesOptSearch(metric=metric, mode=mode)
+    if name == "bohb":
+        from ray.tune.search.bohb import TuneBOHB
+
+        return TuneBOHB(metric=metric, mode=mode)
+    raise ValueError(f"Unknown search_alg: {name!r} (random | bayesopt | bohb)")
+
+
+def get_scheduler(tune_config: Dict[str, Any]):
+    """Trial scheduler by name (`ray_tune/__init__.py:127-149`):
+    ``hyperband`` (ASHA early stopping) or ``fifo`` (None)."""
+    name = (tune_config.get("scheduler") or "fifo").lower()
+    if name in ("fifo", "", "none"):
+        return None
+    if name == "hyperband":
+        from ray.tune.schedulers import AsyncHyperBandScheduler
+
+        return AsyncHyperBandScheduler()
+    raise ValueError(f"Unknown scheduler: {name!r} (fifo | hyperband)")
+
+
 def run_ray_sweep(trainable, param_space, tune_config, num_cpus=4, num_gpus=0):
     """Ray Tune executor (`sweep.py:21-49`); requires ray installed."""
     import ray
@@ -198,6 +230,8 @@ def run_ray_sweep(trainable, param_space, tune_config, num_cpus=4, num_gpus=0):
             mode=tune_config["mode"],
             metric=tune_config["metric"],
             num_samples=tune_config["num_samples"],
+            search_alg=get_search_alg(tune_config),
+            scheduler=get_scheduler(tune_config),
         ),
     )
     results = tuner.fit()

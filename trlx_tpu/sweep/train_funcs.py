@@ -1,0 +1,36 @@
+"""Tune-ready standalone train functions (reference
+``trlx/ray_tune/train_funcs.py:10-32``): each takes a flat hyperparameter
+dict (one sweep trial), merges it into the base config, trains, and returns
+the final stats dict for the sweep executor to rank.
+
+Usable directly as the trainable for ``run_local_sweep`` / ``run_ray_sweep``
+or via ``python -m trlx_tpu.sweep --config ... examples/ppo_sentiments.py``
+(which wraps the example's ``main``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+
+def ppo_randomwalks_train(params: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """PPO on the synthetic randomwalks task — the fast sweep smoke target
+    (the reference's CI-speed example, `examples/randomwalks/`)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from examples.randomwalks import main
+
+    return main(params)
+
+
+def ppo_sentiments_train(params: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """PPO sentiments trainable (`ray_tune/train_funcs.py:10-32`) — requires
+    local gpt2-imdb + sentiment checkpoint paths via env/config."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+    from examples.ppo_sentiments import main
+
+    return main(params)

@@ -432,7 +432,9 @@ class PPOTrainer(BaseRLTrainer):
         self.mean_kl = float(mean_kl)
         return rewards
 
-    def train_on_buffer(self, seed: int = 0) -> Tuple[int, Dict[str, Any]]:
+    def train_on_buffer(
+        self, seed: int = 0
+    ) -> Tuple[int, Dict[str, Any], List[float]]:
         """One fused buffer pass: every minibatch x ``ppo_epochs`` update in a
         single device dispatch (vs one dispatch per update). Returns
         ``(n_steps_taken, stacked_stats, kl_seq)``: each stats leaf has a

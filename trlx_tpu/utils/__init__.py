@@ -98,6 +98,25 @@ def topk_mask(xs: jax.Array, k: int) -> jax.Array:
     return jnp.where(xs < kth, jnp.full_like(xs, -jnp.inf), xs)
 
 
+def sentiment_score(sentiments: Iterable[Any]) -> "jax.Array":
+    """Extract the positive-class score from HF sentiment-pipeline outputs
+    (reference `trlx/utils/__init__.py:122-129`): each entry is a list of
+    ``{"label", "score"}`` dicts; returns the POSITIVE scores as an array."""
+    import jax.numpy as jnp
+
+    scores = []
+    for entry in sentiments:
+        by_label = {d["label"]: d["score"] for d in entry}
+        if "POSITIVE" in by_label:
+            scores.append(by_label["POSITIVE"])
+        else:
+            # generic 2-class heads: positive is the highest label name
+            # (LABEL_1 > LABEL_0) — pipeline output order is score-sorted,
+            # so never index by position
+            scores.append(by_label[max(by_label)])
+    return jnp.asarray(scores, jnp.float32)
+
+
 def tree_map(f, tree: Any) -> Any:
     """Apply ``f`` to every leaf of a pytree (dict/list/tuple/array)."""
     return jax.tree_util.tree_map(f, tree)
