@@ -102,8 +102,21 @@ def train(
         )
         orch.make_experience(list(samples), list(rewards))
 
+        if eval_prompts is None:
+            # derive eval prompts from the samples' prompt portions:
+            # str -> itself; (prompt_str, response_str) -> prompt;
+            # (token_list, action_start) -> tokens before the first action
+            eval_prompts = []
+            for s in list(samples)[:64]:
+                if isinstance(s, str):
+                    eval_prompts.append(s)
+                elif len(s) == 2 and isinstance(s[0], str):
+                    eval_prompts.append(s[0])
+                else:
+                    toks, start = s
+                    eval_prompts.append([int(t) for t in toks[: max(int(start), 1)]])
         eval_pipeline = get_pipeline(config.train.pipeline)(
-            eval_prompts if eval_prompts is not None else list(samples)[:64],
+            eval_prompts,
             trainer.query_length,
             trainer.tokenizer,
         )

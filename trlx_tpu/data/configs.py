@@ -101,6 +101,9 @@ class TrainConfig:
     trainer: str = "PPOTrainer"
 
     checkpoint_dir: str = "ckpts"
+    # restore train state + loop counters from checkpoint_dir before
+    # training (reference Ray-resume path, `accelerate_base_model.py:232-240`)
+    resume_from_checkpoint: bool = False
     project_name: str = "trlx_tpu"
     run_name: str = ""
     seed: int = 1000

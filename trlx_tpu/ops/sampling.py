@@ -142,7 +142,6 @@ def make_sampler(
             cache, logits_last, value_last, finished, rng = carry
             rng, key = jax.random.split(rng)
 
-            raw_logprobs = jax.nn.log_softmax(logits_last, axis=-1)
             if gen_config.forced_bos_token_id >= 0:
                 forced = jnp.full((B,), gen_config.forced_bos_token_id, jnp.int32)
             else:
@@ -157,7 +156,12 @@ def make_sampler(
                 token = jnp.where(t == 0, forced, token)
             token = jnp.where(finished, gen_config.pad_token_id, token)
 
-            logprob = jnp.take_along_axis(raw_logprobs, token[:, None], axis=-1)[:, 0]
+            # behavior logprob under the *raw* logits: gather + logsumexp
+            # (one [B] gather instead of materializing [B, V] log_softmax)
+            logprob = (
+                jnp.take_along_axis(logits_last, token[:, None], axis=-1)[:, 0]
+                - jax.scipy.special.logsumexp(logits_last, axis=-1)
+            )
             live = jnp.logical_not(finished)
             finished = jnp.logical_or(finished, token == gen_config.eos_token_id)
 
@@ -255,7 +259,6 @@ def make_seq2seq_sampler(
             cache, logits_last, value_last, finished, rng = carry
             rng, key = jax.random.split(rng)
 
-            raw_logprobs = jax.nn.log_softmax(logits_last, axis=-1)
             if gen_config.do_sample:
                 filtered = filter_logits(logits_last, gen_config)
                 token = jax.random.categorical(key, filtered, axis=-1)
@@ -270,7 +273,10 @@ def make_seq2seq_sampler(
                 )
             token = jnp.where(finished, gen_config.pad_token_id, token)
 
-            logprob = jnp.take_along_axis(raw_logprobs, token[:, None], axis=-1)[:, 0]
+            logprob = (
+                jnp.take_along_axis(logits_last, token[:, None], axis=-1)[:, 0]
+                - jax.scipy.special.logsumexp(logits_last, axis=-1)
+            )
             live = jnp.logical_not(finished)
             finished = jnp.logical_or(finished, token == gen_config.eos_token_id)
             ys = (token, live.astype(jnp.int32), logprob, value_last)

@@ -115,3 +115,21 @@ class ILQLRolloutStorage(BaseRolloutStore):
             if sharding is not None:
                 mb = jax.device_put(mb, sharding)
             yield mb
+
+    def epoch_order(self, batch_size: int, shuffle: bool = True, seed: int = 0):
+        """Shuffled sample order for one epoch, truncated to whole
+        minibatches — index source for chunked fused training scans."""
+        n = len(self)
+        order = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+        n_mb = n // batch_size
+        return order[: n_mb * batch_size].reshape(n_mb, batch_size)
+
+    def stacked_slice(self, order_rows: np.ndarray, sharding=None) -> ILQLBatch:
+        """Gather minibatch rows [k, B] into a stacked [k, B, ...] pytree
+        (the input of one fused training scan)."""
+        mbs = self.batch.select(jnp.asarray(order_rows))
+        if sharding is not None:
+            mbs = jax.device_put(mbs, sharding)
+        return mbs

@@ -206,15 +206,25 @@ def get_search_alg(tune_config: Dict[str, Any]):
 
 def get_scheduler(tune_config: Dict[str, Any]):
     """Trial scheduler by name (`ray_tune/__init__.py:127-149`):
-    ``hyperband`` (ASHA early stopping) or ``fifo`` (None)."""
-    name = (tune_config.get("scheduler") or "fifo").lower()
-    if name in ("fifo", "", "none"):
-        return None
+    ``hyperband`` (ASHA early stopping), ``bohb`` (HyperBandForBOHB — the
+    scheduler TuneBOHB requires), or ``fifo`` (None). ``search_alg: bohb``
+    implies the bohb scheduler when none is named."""
+    name = (tune_config.get("scheduler") or "").lower()
+    if not name or name in ("fifo", "none"):
+        # TuneBOHB is only valid with HyperBandForBOHB — pair automatically
+        if (tune_config.get("search_alg") or "").lower() == "bohb":
+            name = "bohb"
+        else:
+            return None
     if name == "hyperband":
         from ray.tune.schedulers import AsyncHyperBandScheduler
 
         return AsyncHyperBandScheduler()
-    raise ValueError(f"Unknown scheduler: {name!r} (fifo | hyperband)")
+    if name == "bohb":
+        from ray.tune.schedulers.hb_bohb import HyperBandForBOHB
+
+        return HyperBandForBOHB()
+    raise ValueError(f"Unknown scheduler: {name!r} (fifo | hyperband | bohb)")
 
 
 def run_ray_sweep(trainable, param_space, tune_config, num_cpus=4, num_gpus=0):
@@ -223,15 +233,21 @@ def run_ray_sweep(trainable, param_space, tune_config, num_cpus=4, num_gpus=0):
     from ray import tune
 
     ray.init(ignore_reinit_error=True)
+    search_alg = get_search_alg(tune_config)
+    # metric/mode go to exactly one place: a pre-configured searcher already
+    # carries them, and Ray rejects receiving them twice
+    metric_mode = (
+        {} if search_alg is not None
+        else {"metric": tune_config["metric"], "mode": tune_config["mode"]}
+    )
     tuner = tune.Tuner(
         tune.with_resources(trainable, resources={"cpu": num_cpus, "gpu": num_gpus}),
         param_space={k: p.to_ray() for k, p in param_space.items()},
         tune_config=tune.TuneConfig(
-            mode=tune_config["mode"],
-            metric=tune_config["metric"],
             num_samples=tune_config["num_samples"],
-            search_alg=get_search_alg(tune_config),
+            search_alg=search_alg,
             scheduler=get_scheduler(tune_config),
+            **metric_mode,
         ),
     )
     results = tuner.fit()

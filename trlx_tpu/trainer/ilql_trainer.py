@@ -213,6 +213,23 @@ class ILQLTrainer(BaseRLTrainer):
             donate_argnums=(0,),
         )
 
+        # chunked fused scan: k consecutive updates in one dispatch (the
+        # in-graph lax.cond target sync keys off state.step, so scanning
+        # preserves the sync schedule exactly)
+        from trlx_tpu.parallel.mesh import stacked_batch_sharding
+
+        self._stacked_batch_sh = stacked_batch_sharding(self.mesh)
+
+        def train_chunk(state, mbs):
+            return jax.lax.scan(train_step, state, mbs)
+
+        self._train_chunk_jit = jax.jit(
+            train_chunk,
+            in_shardings=(self.state_shardings, self._stacked_batch_sh),
+            out_shardings=(self.state_shardings, rep),
+            donate_argnums=(0,),
+        )
+
         # --- advantage-shifted sampler (`ilql_models.py:257-327`) ---
         def sample_apply(bundle, input_ids, attention_mask=None, position_ids=None,
                          cache=None, cache_index=None, last_only=False):
@@ -281,6 +298,15 @@ class ILQLTrainer(BaseRLTrainer):
         if self.store is None:
             raise ValueError("no offline data: run OfflineOrchestrator.make_experience")
 
+        # resume (reference Ray session restore, `accelerate_base_model.py:
+        # 232-240`)
+        import os
+
+        if train.resume_from_checkpoint and os.path.isdir(
+            os.path.join(train.checkpoint_dir, "state")
+        ):
+            self.load(train.checkpoint_dir)
+
         n_minibatches = max(len(self.store) // train.batch_size, 1)
         total_steps = min(train.total_steps, train.epochs * n_minibatches)
 
@@ -295,27 +321,52 @@ class ILQLTrainer(BaseRLTrainer):
         logger.log(stats, step=0)
 
         clock = Clock()
-        iter_count = 0
+        iter_count = int(self.state.step)  # nonzero after resume
+        if iter_count >= total_steps:
+            logger.finish()
+            self._final_stats = {}
+            return {}
         final_stats: Dict[str, Any] = {}
+        # Chunked fused loop: consecutive updates up to the next eval/save
+        # boundary (or total_steps) run as one scanned dispatch; per-step log
+        # rows are replayed from the stacked stats, so cadence matches the
+        # stepwise loop exactly.
+        MAX_CHUNK = 32
+
+        def next_chunk_len(step: int, remaining_mbs: int) -> int:
+            k = min(MAX_CHUNK, remaining_mbs, total_steps - step)
+            for boundary in (train.eval_interval, train.checkpoint_interval):
+                to_boundary = boundary - (step % boundary)
+                k = min(k, to_boundary)
+            return max(k, 1)
+
         for epoch in range(train.epochs):
-            for mb in self.store.create_loader(
-                train.batch_size,
-                shuffle=True,
-                seed=train.seed + epoch,
-                sharding=batch_sharding(self.mesh),
-            ):
-                self.state, step_stats = self._train_step_jit(self.state, mb)
-                iter_count += 1
-                step_stats["time/batch"] = clock.tick(train.batch_size) / 1000.0
+            order = self.store.epoch_order(
+                train.batch_size, shuffle=True, seed=train.seed + epoch
+            )
+            row = 0
+            while row < len(order):
+                k = next_chunk_len(iter_count, len(order) - row)
+                mbs = self.store.stacked_slice(
+                    order[row : row + k], sharding=self._stacked_batch_sh
+                )
+                row += k
+                self.state, stacked = self._train_chunk_jit(self.state, mbs)
+                chunk_time = clock.tick(train.batch_size) / 1000.0
+                rows = {key: np.asarray(v) for key, v in stacked.items()}
+                for j in range(k):
+                    iter_count += 1
+                    step_stats = {key: float(v[j]) for key, v in rows.items()}
+                    step_stats["time/batch"] = chunk_time / k
+                    if iter_count % train.log_interval == 0:
+                        logger.log(step_stats, step=iter_count)
+                        final_stats = dict(step_stats)
                 iv = self.intervals(iter_count)
-                if iv["do_log"]:
-                    logger.log(step_stats, step=iter_count)
-                    final_stats = {k: float(v) for k, v in step_stats.items()}
-                if iv["do_eval"]:
+                if iv["do_eval"] and iter_count < total_steps:
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
-                if iv["do_save"]:
+                if iv["do_save"] and iter_count < total_steps:
                     self.save()
                 if iter_count >= total_steps:
                     self.save()

@@ -28,6 +28,7 @@ lives in ``seq2seq_ppo_trainer.py``.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -472,6 +473,18 @@ class PPOTrainer(BaseRLTrainer):
         train = self.config.train
         method: PPOConfig = self.config.method
 
+        # resume (reference Ray session restore, `accelerate_base_model.py:
+        # 232-240`): restore params/opt/step + KL-controller state, continue
+        # the step count from the checkpoint
+        if train.resume_from_checkpoint and os.path.isdir(
+            os.path.join(train.checkpoint_dir, "state")
+        ):
+            self.load(train.checkpoint_dir)
+            if int(self.state.step) >= train.total_steps:
+                # finished run: skip rollout collection entirely
+                self._final_stats = {}
+                return {}
+
         if len(self.buffer) == 0 and self.orch is not None:
             self.orch.make_experience(method.num_rollouts, 0)
 
@@ -494,8 +507,13 @@ class PPOTrainer(BaseRLTrainer):
             logger.log_samples(self._last_samples[1], self._last_samples[0], step=0)
 
         clock = Clock()
-        iter_count = 0
+        iter_count = int(self.state.step)  # nonzero after resume
         final_stats: Dict[str, Any] = {}
+        if iter_count >= total_steps:
+            # resumed a finished run: nothing left to train
+            logger.finish()
+            self._final_stats = final_stats
+            return final_stats
         profiling = False
         if train.profile_dir:
             jax.profiler.start_trace(train.profile_dir)
