@@ -25,9 +25,10 @@ def dense_reference(q, k, v, kv_mask, causal):
     )
 
 
+@pytest.mark.parametrize("impl", ["flash", "naive"])
 @pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("sp", [2, 4])
-def test_ring_matches_dense(causal, sp):
+def test_ring_matches_dense(causal, sp, impl):
     import jax
     import jax.numpy as jnp
 
@@ -46,7 +47,7 @@ def test_ring_matches_dense(causal, sp):
 
     out = ring_attention_sharded(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh,
-        kv_mask=jnp.asarray(kv_mask), causal=causal,
+        kv_mask=jnp.asarray(kv_mask), causal=causal, impl=impl,
     )
     expected = dense_reference(q, k, v, kv_mask, causal)
     np.testing.assert_allclose(
@@ -84,3 +85,44 @@ def test_ring_attention_jits_and_grads():
 
     g_dense = jax.grad(dense_loss)(q, k, v)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_dense), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_grads_match_dense(causal):
+    """The ring-flash custom VJP (second ring pass recomputing block scores
+    from the saved logsumexp) must match dense autodiff, including key
+    padding and both impls against each other."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.ring_attention import ring_attention_sharded
+    from trlx_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": -1, "fsdp": 1, "tp": 1, "sp": 4})
+    rng = np.random.default_rng(2)
+    B, T, H, D = 2, 16, 2, 4
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    kv_mask = np.ones((B, T), np.int32)
+    kv_mask[1, T - 5 :] = 0
+    mask = jnp.asarray(kv_mask)
+
+    def loss(impl):
+        def f(q, k, v):
+            out = ring_attention_sharded(
+                q, k, v, mesh, kv_mask=mask, causal=causal, impl=impl
+            )
+            return jnp.sum(out ** 2)
+        return f
+
+    g_flash = jax.jit(jax.grad(loss("flash"), argnums=(0, 1, 2)))(q, k, v)
+    g_naive = jax.jit(jax.grad(loss("naive"), argnums=(0, 1, 2)))(q, k, v)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(dense_reference(q, k, v, kv_mask, causal) ** 2)
+
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, c in zip(g_flash, g_naive, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)

@@ -62,12 +62,7 @@ def ring_attention(
         logits = jnp.einsum(
             "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
         ) * scale
-        bias = jnp.where(mask_blk[:, None, None, :] > 0, 0.0, NEG_INF)
-        if causal:
-            bias = bias + jnp.where(
-                k_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF
-            )[None, None]
-        logits = logits + bias
+        logits = logits + _block_bias(mask_blk, q_pos, k_pos, causal)
 
         # online softmax update
         blk_max = jnp.max(logits, axis=-1)  # [B, H, Tq]
@@ -98,6 +93,152 @@ def ring_attention(
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Tq, H, D]
 
 
+# ---------------------------------------------------------------------------
+# Ring flash attention: blockwise (o, lse) accumulation + custom two-pass VJP
+# ---------------------------------------------------------------------------
+
+
+def _block_bias(mask_blk, q_pos, k_pos, causal):
+    """[B, 1, Tq, Tk] additive bias from key validity + causal positions."""
+    bias = jnp.where(mask_blk[:, None, None, :] > 0, 0.0, NEG_INF)
+    if causal:
+        bias = bias + jnp.where(
+            k_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF
+        )[None, None]
+    return bias
+
+
+def _block_fwd(q, k_blk, v_blk, bias, scale):
+    """Per-block attention with logsumexp.
+
+    q [B, Tq, H, D]; k/v [B, Tk, H, D]; bias [B, 1, Tq, Tk].
+    Returns (o [B, H, Tq, D] f32 — softmax-normalized within the block,
+    lse [B, H, Tq] f32).
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k_blk.astype(jnp.float32)
+    ) * scale + bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p / jnp.maximum(l, 1e-30),
+                   v_blk.astype(jnp.float32))
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    return o, lse
+
+
+def _ring_fwd(q, k, v, kv_mask, axis_name, causal):
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = float(1.0 / (D ** 0.5))
+    q_pos = idx * Tq + jnp.arange(Tq)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        out, lse, k_blk, v_blk, mask_blk = carry
+        src = (idx - i) % n
+        k_pos = src * Tk + jnp.arange(Tk)
+        bias = _block_bias(mask_blk, q_pos, k_pos, causal)
+        o_i, lse_i = _block_fwd(q, k_blk, v_blk, bias, scale)
+
+        # combine softmax-normalized block results by their logsumexp weights
+        m_new = jnp.maximum(lse, lse_i)
+        w_old = jnp.exp(lse - m_new)
+        w_new = jnp.exp(lse_i - m_new)
+        denom = jnp.maximum(w_old + w_new, 1e-30)
+        out = (out * w_old[..., None] + o_i * w_new[..., None]) / denom[..., None]
+        lse = m_new + jnp.log(denom)
+
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+        return out, lse, k_blk, v_blk, mask_blk
+
+    # zeros derived from q for consistent shard_map vma typing
+    zero_bhqd = jnp.transpose(q.astype(jnp.float32) * 0.0, (0, 2, 1, 3))
+    out0 = zero_bhqd
+    lse0 = zero_bhqd[..., 0] - jnp.inf
+    out, lse, _, _, _ = jax.lax.fori_loop(
+        0, n, step, (out0, lse0, k, v, kv_mask)
+    )
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype), lse
+
+
+def _ring_bwd(q, k, v, kv_mask, out, lse, dout, axis_name, causal):
+    """Second ring pass: recompute per-block softmax weights from the saved
+    global logsumexp (exact — no stored score matrices) and accumulate dq
+    locally while dk/dv ride the rotating buffers; after the full circle
+    each block's gradients land back on its home device."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = float(1.0 / (D ** 0.5))
+    q_pos = idx * Tq + jnp.arange(Tq)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q32 = q.astype(jnp.float32)
+    do = jnp.transpose(dout.astype(jnp.float32), (0, 2, 1, 3))  # [B,H,Tq,D]
+    o32 = jnp.transpose(out.astype(jnp.float32), (0, 2, 1, 3))
+    delta = jnp.sum(do * o32, axis=-1)  # [B, H, Tq]
+
+    def step(i, carry):
+        dq, k_blk, v_blk, mask_blk, dk_blk, dv_blk = carry
+        src = (idx - i) % n
+        k_pos = src * Tk + jnp.arange(Tk)
+        bias = _block_bias(mask_blk, q_pos, k_pos, causal)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+        ) * scale + bias
+        p = jnp.exp(s - lse[..., None])  # exact softmax weights [B,H,Tq,Tk]
+        dv_blk = dv_blk + jnp.einsum("bhqk,bhqd->bkhd", p, do)
+        dp = jnp.einsum("bhqd,bkhd->bhqk", do, v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk.astype(jnp.float32))
+        dk_blk = dk_blk + jnp.einsum("bhqk,bqhd->bkhd", ds, q32)
+
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+        dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+        return dq, k_blk, v_blk, mask_blk, dk_blk, dv_blk
+
+    dq0 = q32 * 0.0
+    dkv0 = jnp.zeros_like(k, dtype=jnp.float32)
+    dq, _, _, _, dk, dv = jax.lax.fori_loop(
+        0, n, step, (dq0, k, v, kv_mask, dkv0, jnp.zeros_like(dkv0))
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def ring_flash_attention(q, k, v, kv_mask, axis_name="sp", causal=True):
+    """Ring attention with flash-style memory: the backward pass recomputes
+    block scores from the saved (output, logsumexp) instead of autodiff
+    storing every rotation's [Tq, Tk] score matrix — per-device residual
+    memory is O(Tq·D) rather than O(Tq·T_global). Same semantics/layout as
+    :func:`ring_attention`; call inside shard_map."""
+    out, _ = _ring_fwd(q, k, v, kv_mask, axis_name, causal)
+    return out
+
+
+def _rfa_fwd(q, k, v, kv_mask, axis_name, causal):
+    out, lse = _ring_fwd(q, k, v, kv_mask, axis_name, causal)
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _rfa_bwd(axis_name, causal, res, dout):
+    q, k, v, kv_mask, out, lse = res
+    dq, dk, dv = _ring_bwd(q, k, v, kv_mask, out, lse, dout, axis_name, causal)
+    return dq, dk, dv, None
+
+
+ring_flash_attention.defvjp(_rfa_fwd, _rfa_bwd)
+
+
 def ring_attention_sharded(
     q: jax.Array,  # [B, T, H, D] global arrays
     k: jax.Array,
@@ -107,14 +248,26 @@ def ring_attention_sharded(
     axis_name: str = "sp",
     batch_axes=("dp", "fsdp"),
     causal: bool = True,
+    impl: str = "flash",  # "flash" (recompute bwd) | "naive" (autodiff)
 ) -> jax.Array:
-    """shard_map wrapper: shards T over ``axis_name``, B over batch axes."""
+    """shard_map wrapper: shards T over ``axis_name``, B over batch axes.
+
+    ``impl="flash"`` (default) uses :func:`ring_flash_attention`, whose
+    custom VJP recomputes block scores in a second ring pass — per-device
+    residuals stay O(Tq·D) at any global length. ``impl="naive"`` keeps the
+    autodiff path (stores each rotation's score panel; useful as a
+    reference)."""
     from jax import shard_map
 
     qkv_spec = P(batch_axes, axis_name, None, None)
     mask_spec = P(batch_axes, axis_name)
 
-    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    if impl not in ("flash", "naive"):
+        raise ValueError(f"impl must be 'flash' or 'naive', got {impl!r}")
+    base = ring_flash_attention if impl == "flash" else ring_attention
+
+    def fn(q, k, v, m):  # custom_vjp requires positional args
+        return base(q, k, v, m, axis_name, causal)
     if kv_mask is None:
         kv_mask = jnp.ones(q.shape[:2], jnp.int32)
     return shard_map(
