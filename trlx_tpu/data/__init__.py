@@ -34,6 +34,15 @@ class RLElement:
     reward: float = 0.0
 
 
+@dataclass
+class SimElement:
+    """Simulacra-style content/preference pair (reference
+    `data/__init__.py:34-47`)."""
+
+    content: Any = None
+    preference: Any = None
+
+
 @struct.dataclass
 class PromptBatch:
     """Tokenized prompt batch, left-padded to a fixed length.

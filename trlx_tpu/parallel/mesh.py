@@ -30,6 +30,8 @@ AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"  # sequence/context parallel (ring attention, ops/ring_attention.py)
+AXIS_PP = "pp"  # pipeline parallel (GPipe microbatching, parallel/pipeline.py)
+AXIS_EP = "ep"  # expert parallel (switch MoE routing, parallel/moe.py)
 # Batch axes: data is sharded over both dp and fsdp mesh axes.
 BATCH_AXES = (AXIS_DP, AXIS_FSDP)
 
@@ -53,6 +55,8 @@ def make_mesh(
         AXIS_FSDP: mesh_config.get(AXIS_FSDP, 1),
         AXIS_TP: mesh_config.get(AXIS_TP, 1),
         AXIS_SP: mesh_config.get(AXIS_SP, 1),
+        AXIS_PP: mesh_config.get(AXIS_PP, 1),
+        AXIS_EP: mesh_config.get(AXIS_EP, 1),
     }
     unknown = set(mesh_config) - set(sizes)
     if unknown:
@@ -71,9 +75,14 @@ def make_mesh(
     elif fixed != n:
         raise ValueError(f"Mesh {sizes} needs {fixed} devices, have {n}")
 
-    shape = (sizes[AXIS_DP], sizes[AXIS_FSDP], sizes[AXIS_TP], sizes[AXIS_SP])
+    shape = (
+        sizes[AXIS_DP], sizes[AXIS_FSDP], sizes[AXIS_TP], sizes[AXIS_SP],
+        sizes[AXIS_PP], sizes[AXIS_EP],
+    )
     device_array = np.asarray(devices).reshape(shape)
-    return Mesh(device_array, (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP))
+    return Mesh(
+        device_array, (AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_PP, AXIS_EP)
+    )
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

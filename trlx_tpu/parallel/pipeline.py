@@ -1,0 +1,123 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+Beyond the reference (SURVEY §2.9: pipeline parallel "NO ... not required
+for parity; optional") — provided as a first-class mesh primitive so deep
+models can shard *layers* over a ``pp`` axis when tensor parallelism alone
+runs out of headroom. TPU-native design: every pp device runs the same
+compiled program inside ``shard_map``; activations hop to the next stage via
+``ppermute`` over ICI each tick, and the classic GPipe schedule (S + M - 1
+ticks for S stages x M microbatches) is a ``lax.fori_loop`` with masked
+writes — no host control flow.
+
+The primitive is deliberately model-agnostic: ``stage_fn(stage_params, h)
+-> h`` with shape-preserving activations, stage params stacked on a leading
+[S] axis (sharded over ``pp``). Autodiff works through the schedule
+(``ppermute`` transposes to the inverse permutation), so this composes with
+training, not just inference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage param pytrees on a leading [S] axis (shard over pp)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *params_list
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,  # [B, ...] activations entering stage 0
+    mesh: Mesh,
+    axis_name: str = "pp",
+    num_microbatches: int = 2,
+    batch_axes=("dp", "fsdp"),
+) -> jax.Array:
+    """Run ``x`` through S pipeline stages with M microbatches.
+
+    ``stacked_params`` leaves are [S, ...] (stage-major) with S equal to the
+    ``pp`` axis size (one stage per device); stage s applies
+    ``stage_fn(params[s], h)``. ``num_microbatches`` must divide the
+    *per-batch-shard* size ``x.shape[0] / (dp*fsdp)``. Returns activations
+    after the last stage, with the same sharding as ``x``.
+    """
+    S = mesh.shape[axis_name]
+    M = num_microbatches
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stacked stage params have leading dim {leaf.shape[0]} but "
+                f"the {axis_name!r} axis has {S} devices (one stage per "
+                f"device); extra stages would be silently dropped"
+            )
+    n_batch_shards = int(np.prod([mesh.shape[a] for a in batch_axes]))
+    B_local = x.shape[0] // n_batch_shards
+    if x.shape[0] % n_batch_shards or B_local % M:
+        raise ValueError(
+            f"batch {x.shape[0]} must divide into {n_batch_shards} shards of "
+            f"{M} microbatches"
+        )
+
+    def local(params, x):
+        # params leaves arrive as [1, ...] (this device's stage); x is this
+        # device's batch shard, replicated over the pp axis.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis_name)
+        n = jax.lax.psum(1, axis_name)
+        b = x.shape[0]
+        mbs = x.reshape((M, b // M) + x.shape[1:]).astype(x.dtype)
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        # carries must be pp-varying from the start (shard_map vma typing):
+        # derive a pp-varying zero from axis_index
+        pp_zero = (0.0 * jax.lax.axis_index(axis_name)).astype(x.dtype)
+        buf0 = jnp.zeros_like(mbs[0]) + pp_zero
+        outs0 = jnp.zeros_like(mbs) + pp_zero
+
+        def tick(t, carry):
+            buf, outs = carry
+            m = t - idx  # microbatch this stage works on at tick t
+            active = jnp.logical_and(m >= 0, m < M)
+            m_c = jnp.clip(m, 0, M - 1)
+            # stage 0 pulls from the microbatch stream; others from the wire
+            h_in = jnp.where(idx == 0, mbs[m_c], buf)
+            h_out = stage_fn(params, h_in)
+            # collect finished microbatches on the last stage
+            outs = jnp.where(
+                jnp.logical_and(idx == n - 1, active),
+                outs.at[m_c].set(h_out),
+                outs,
+            )
+            # hand the activation to the next stage (masked when idle so
+            # garbage never overwrites a live microbatch downstream)
+            wire = jnp.where(active, h_out, buf * 0.0)
+            buf = jax.lax.ppermute(wire, axis_name, perm)
+            return buf, outs
+
+        _, outs = jax.lax.fori_loop(0, S + M - 1, tick, (buf0, outs0))
+        # only the last stage holds real outputs; broadcast over the pp axis
+        outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
+        outs = jax.lax.psum(outs, axis_name)
+        return outs.reshape(x.shape)
+
+    from jax import shard_map
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stacked_params
+    )
+    x_spec = P(batch_axes)
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+    )(stacked_params, x)
