@@ -444,6 +444,100 @@ def _flash_bwd(scale, block_q, block_k, causal, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _prep_block_inputs(q, k, v, bias, block_q, block_k, interpret, scale):
+    """Shared prologue for the kernel entry points: interpret default,
+    shrink-to-ceil8 tile sizes, [B, H, T, D] transpose + tile padding, bias
+    padding/masking, default 1/sqrt(D) scale."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    D = q.shape[-1]
+    if scale is None:
+        scale = float(1.0 / (D ** 0.5))
+    Q, K = q.shape[1], k.shape[1]
+    block_q = min(block_q, max(8, -(-Q // 8) * 8))
+    block_k = min(block_k, max(8, -(-K // 8) * 8))
+    qt, _ = _pad_to(jnp.transpose(q, (0, 2, 1, 3)), 2, block_q)
+    kt, _ = _pad_to(jnp.transpose(k, (0, 2, 1, 3)), 2, block_k)
+    vt, _ = _pad_to(jnp.transpose(v, (0, 2, 1, 3)), 2, block_k)
+    bias = _prepare_bias(bias, kt.shape[2], K, block_q, block_k)
+    return qt, kt, vt, bias, block_q, block_k, interpret, scale
+
+
+def flash_block_fwd(q, k, v, bias, scale: Optional[float] = None,
+                    block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """Single-block forward returning the logsumexp — the building block for
+    cross-block softmax combination (ring attention over the sp axis).
+
+    q [B, Tq, H, D], k/v [B, Tk, H, D], bias broadcastable to
+    [B, H, Tq, Tk]; returns (o [B, H, Tq, D] softmax-normalized in q.dtype,
+    lse [B, H, Tq] f32). No causal flag: ring blocks carry positions in the
+    bias. Not differentiable by itself — ring's custom VJP calls
+    :func:`flash_block_bwd`.
+    """
+    Q = q.shape[1]
+    qt, kt, vt, bias, block_q, block_k, interpret, scale = _prep_block_inputs(
+        q, k, v, bias, block_q, block_k, interpret, scale
+    )
+    o, lse = _fwd(
+        qt, kt, vt, bias, scale=scale, block_q=block_q, block_k=block_k,
+        causal=False, interpret=interpret,
+    )
+    return o[:, :, :Q, :], lse[:, :, :Q, 0]
+
+
+def flash_block_bwd(q, k, v, bias, o, lse, do, scale: Optional[float] = None,
+                    block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """Single-block backward against an *external* (combined) logsumexp.
+
+    Layouts: q/k/v [B, T, H, D]; o/do [B, H, Tq, D]; lse [B, H, Tq].
+    Returns (dq [B, Tq, H, D], dk, dv [B, Tk, H, D]) in f32. Because ``lse``
+    may come from combining many blocks, p = exp(s - lse) are the *global*
+    softmax weights — exactly what the flash backward recomputes. Inputs are
+    upcast to f32 so ring-accumulated gradients match the XLA block math
+    bit-for-bit regardless of the activations' dtype.
+    """
+    B, Q, H, D = q.shape
+    K = k.shape[1]
+    qt, kt, vt, bias, block_q, block_k, interpret, scale = _prep_block_inputs(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        bias, block_q, block_k, interpret, scale,
+    )
+    Qp = qt.shape[2]
+    op, _ = _pad_to(o.astype(jnp.float32), 2, block_q)
+    dop, _ = _pad_to(do.astype(jnp.float32), 2, block_q)
+    lse_p = jnp.broadcast_to(
+        _pad_to(lse, 2, block_q)[0][..., None], (B, H, Qp, LANES)
+    )
+    dq, dk, dv = _bwd(
+        qt, kt, vt, bias, op, lse_p, dop, scale=scale, block_q=block_q,
+        block_k=block_k, causal=False, interpret=interpret,
+    )
+    dq = jnp.transpose(dq[:, :, :Q, :], (0, 2, 1, 3))
+    dk = jnp.transpose(dk[:, :, :K, :], (0, 2, 1, 3))
+    dv = jnp.transpose(dv[:, :, :K, :], (0, 2, 1, 3))
+    return dq, dk, dv
+
+
+def _prepare_bias(bias, Kp, K, block_q, block_k):
+    """Pad a [b?, h?, Q?, K?] bias to tile multiples and mask padded keys."""
+    if bias is not None:
+        if bias.ndim != 4:
+            raise ValueError(f"bias must be rank-4, got {bias.shape}")
+        bias = bias.astype(jnp.float32)
+        if bias.shape[3] > 1:
+            bias, _ = _pad_to(bias, 3, block_k)
+        if bias.shape[2] > 1:
+            bias, _ = _pad_to(bias, 2, block_q)
+    if Kp != K:
+        pad_bias = jnp.where(
+            jnp.arange(Kp)[None, None, None, :] < K, 0.0, NEG_INF
+        ).astype(jnp.float32)
+        bias = pad_bias if bias is None else bias + pad_bias
+    return bias
+
+
 def _pad_to(x, axis, multiple):
     size = x.shape[axis]
     rem = -size % multiple
@@ -476,38 +570,10 @@ def flash_attention(
     the training / prefill case. For cache decode at an offset, pass an
     explicit bias.
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    B, Q, H, D = q.shape
-    K = k.shape[1]
-    scale = float(1.0 / (D ** 0.5))
-
-    block_q = min(block_q, max(8, -(-Q // 8) * 8))  # small-Q: shrink tile
-    block_k = min(block_k, max(8, -(-K // 8) * 8))
-
-    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, Q, D]
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-    qt, _ = _pad_to(qt, 2, block_q)
-    kt, _ = _pad_to(kt, 2, block_k)
-    vt, _ = _pad_to(vt, 2, block_k)
-    Kp = kt.shape[2]
-
-    if bias is not None:
-        if bias.ndim != 4:
-            raise ValueError(f"bias must be rank-4, got {bias.shape}")
-        bias = bias.astype(jnp.float32)
-        if bias.shape[3] > 1:
-            bias, _ = _pad_to(bias, 3, block_k)  # zeros; masked next
-        if bias.shape[2] > 1:
-            bias, _ = _pad_to(bias, 2, block_q)
-    if Kp != K:
-        # mask padded keys for every query row (broadcasts over size-1 dims)
-        pad_bias = jnp.where(
-            jnp.arange(Kp)[None, None, None, :] < K, 0.0, NEG_INF
-        ).astype(jnp.float32)
-        bias = pad_bias if bias is None else bias + pad_bias
-
+    Q = q.shape[1]
+    qt, kt, vt, bias, block_q, block_k, interpret, scale = _prep_block_inputs(
+        q, k, v, bias, block_q, block_k, interpret, None
+    )
     out = _flash(qt, kt, vt, bias, scale, block_q, block_k, causal, interpret)
     out = out[:, :, :Q, :]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

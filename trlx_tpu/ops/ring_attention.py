@@ -108,13 +108,28 @@ def _block_bias(mask_blk, q_pos, k_pos, causal):
     return bias
 
 
+def _use_pallas_blocks(Tq: int, Tk: int) -> bool:
+    """Per-device block sizes above which the pallas kernels take over the
+    inner block computation on TPU (below, XLA's fused path wins — the same
+    measured crossover as the dense dispatch)."""
+    from trlx_tpu.ops.attention import FLASH_MIN_SEQ
+
+    return min(Tq, Tk) >= FLASH_MIN_SEQ and jax.default_backend() == "tpu"
+
+
 def _block_fwd(q, k_blk, v_blk, bias, scale):
     """Per-block attention with logsumexp.
 
     q [B, Tq, H, D]; k/v [B, Tk, H, D]; bias [B, 1, Tq, Tk].
     Returns (o [B, H, Tq, D] f32 — softmax-normalized within the block,
-    lse [B, H, Tq] f32).
+    lse [B, H, Tq] f32). Large blocks on TPU run the pallas flash kernel
+    (the [Tq, Tk] score matrix stays in VMEM tiles).
     """
+    if _use_pallas_blocks(q.shape[1], k_blk.shape[1]):
+        from trlx_tpu.ops.flash_attention import flash_block_fwd
+
+        o, lse = flash_block_fwd(q, k_blk, v_blk, bias, scale=scale)
+        return o.astype(jnp.float32), lse
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32), k_blk.astype(jnp.float32)
     ) * scale + bias
@@ -125,6 +140,33 @@ def _block_fwd(q, k_blk, v_blk, bias, scale):
                    v_blk.astype(jnp.float32))
     lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
     return o, lse
+
+
+def _block_bwd(q, k_blk, v_blk, bias, o, lse, do, delta, scale):
+    """Per-block gradients against the *global* (combined) logsumexp.
+
+    ``o``/``do``/``delta`` are the GLOBAL combined output, its cotangent,
+    and ``rowsum(do*o)`` — shared by every block of a ring pass (the flash
+    backward's delta term is global by definition). Layouts: q [B,Tq,H,D],
+    k/v [B,Tk,H,D], o/do [B,H,Tq,D], lse/delta [B,H,Tq]. Returns
+    (dq [B,Tq,H,D], dk, dv [B,Tk,H,D]) in f32. Large blocks on TPU run the
+    pallas backward kernels.
+    """
+    if _use_pallas_blocks(q.shape[1], k_blk.shape[1]):
+        from trlx_tpu.ops.flash_attention import flash_block_bwd
+
+        return flash_block_bwd(q, k_blk, v_blk, bias, o, lse, do, scale=scale)
+    q32 = q.astype(jnp.float32)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+    ) * scale + bias
+    p = jnp.exp(s - lse[..., None])  # global softmax weights
+    dv = jnp.einsum("bhqk,bhqd->bkhd", p, do)
+    dp = jnp.einsum("bhqd,bkhd->bhqk", do, v_blk.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q32)
+    return dq, dk, dv
 
 
 def _ring_fwd(q, k, v, kv_mask, axis_name, causal):
@@ -189,15 +231,12 @@ def _ring_bwd(q, k, v, kv_mask, out, lse, dout, axis_name, causal):
         src = (idx - i) % n
         k_pos = src * Tk + jnp.arange(Tk)
         bias = _block_bias(mask_blk, q_pos, k_pos, causal)
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
-        ) * scale + bias
-        p = jnp.exp(s - lse[..., None])  # exact softmax weights [B,H,Tq,Tk]
-        dv_blk = dv_blk + jnp.einsum("bhqk,bhqd->bkhd", p, do)
-        dp = jnp.einsum("bhqd,bkhd->bhqk", do, v_blk.astype(jnp.float32))
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk.astype(jnp.float32))
-        dk_blk = dk_blk + jnp.einsum("bhqk,bqhd->bkhd", ds, q32)
+        dq_i, dk_i, dv_i = _block_bwd(
+            q, k_blk, v_blk, bias, o32, lse, do, delta, scale
+        )
+        dq = dq + dq_i
+        dk_blk = dk_blk + dk_i
+        dv_blk = dv_blk + dv_i
 
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
@@ -270,9 +309,15 @@ def ring_attention_sharded(
         return base(q, k, v, m, axis_name, causal)
     if kv_mask is None:
         kv_mask = jnp.ones(q.shape[:2], jnp.int32)
+    # pallas_call outputs carry no vma annotation, which trips shard_map's
+    # varying-axes type check — disable it only when the pallas block path
+    # will actually run; the pure-XLA paths keep the safety check.
+    sp = mesh.shape[axis_name]
+    pallas_blocks = _use_pallas_blocks(q.shape[1] // sp, k.shape[1] // sp)
     return shard_map(
         fn,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,
+        check_vma=not pallas_blocks,
     )(q, k, v, kv_mask)
