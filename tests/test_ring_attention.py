@@ -126,3 +126,51 @@ def test_ring_flash_grads_match_dense(causal):
     for a, b, c in zip(g_flash, g_naive, g_dense):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=2e-4)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_ring_pallas_inner_integration_interpret():
+    """The pallas block kernels wired into the ring (lse handoff into the
+    cross-block combine, flash_block_bwd from the ring VJP) — forced on and
+    run in interpret mode so CI covers the integration without a TPU."""
+    import jax
+    import jax.numpy as jnp
+
+    import trlx_tpu.ops.ring_attention as ra
+    from trlx_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": -1, "fsdp": 1, "tp": 1, "sp": 2})
+    rng = np.random.default_rng(3)
+    B, T, H, D = 4, 16, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    kv_mask = np.ones((B, T), np.int32)
+    kv_mask[0, T - 3 :] = 0  # noqa: same mask row exercised across shards
+
+    old = ra._FORCE_PALLAS_BLOCKS
+    ra._FORCE_PALLAS_BLOCKS = True
+    try:
+        out = ra.ring_attention_sharded(
+            q, k, v, mesh, kv_mask=jnp.asarray(kv_mask), causal=True
+        )
+        expected = dense_reference(q, k, v, kv_mask, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expected), atol=2e-5
+        )
+
+        def loss(q, k, v):
+            o = ra.ring_attention_sharded(
+                q, k, v, mesh, kv_mask=jnp.asarray(kv_mask), causal=True
+            )
+            return jnp.sum(o ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        ra._FORCE_PALLAS_BLOCKS = old
+
+    def dense_loss(q, k, v):
+        return jnp.sum(dense_reference(q, k, v, kv_mask, True) ** 2)
+
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)

@@ -262,15 +262,30 @@ class CausalLMWithILQLHeads(nn.Module):
         states_ixs: Optional[jax.Array] = None,
         cache=None,
         cache_index=None,
+        last_only: bool = False,
     ):
+        """``last_only=True``: logits and Q/V heads only for the final
+        position (sampler prefill — the advantage-shifted decode reads one
+        row; without this the prefill writes [B, Q, vocab] logits plus
+        per-position Q/V for the whole prompt)."""
         out = self.backbone(
             input_ids,
             attention_mask=attention_mask,
             position_ids=position_ids,
             cache=cache,
             cache_index=cache_index,
+            compute_logits=not last_only,
         )
         hidden = out["hidden"]
+        if last_only:
+            if actions_ixs is not None or states_ixs is not None:
+                raise ValueError(
+                    "last_only truncates hidden to the final position; "
+                    "actions_ixs/states_ixs gathers would silently clamp "
+                    "to it — these options are mutually exclusive"
+                )
+            hidden = hidden[:, -1:]
+            out["logits"] = self.backbone.logits(hidden)
         if actions_ixs is not None:
             action_hidden = jnp.take_along_axis(
                 hidden, actions_ixs[..., None], axis=1

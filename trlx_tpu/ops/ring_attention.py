@@ -108,10 +108,17 @@ def _block_bias(mask_blk, q_pos, k_pos, causal):
     return bias
 
 
+# None -> auto (TPU + size crossover); True/False -> force. Tests force True
+# to run the ring+pallas integration in interpret mode on CPU.
+_FORCE_PALLAS_BLOCKS = None
+
+
 def _use_pallas_blocks(Tq: int, Tk: int) -> bool:
     """Per-device block sizes above which the pallas kernels take over the
     inner block computation on TPU (below, XLA's fused path wins — the same
     measured crossover as the dense dispatch)."""
+    if _FORCE_PALLAS_BLOCKS is not None:
+        return _FORCE_PALLAS_BLOCKS
     from trlx_tpu.ops.attention import FLASH_MIN_SEQ
 
     return min(Tq, Tk) >= FLASH_MIN_SEQ and jax.default_backend() == "tpu"
@@ -311,9 +318,12 @@ def ring_attention_sharded(
         kv_mask = jnp.ones(q.shape[:2], jnp.int32)
     # pallas_call outputs carry no vma annotation, which trips shard_map's
     # varying-axes type check — disable it only when the pallas block path
-    # will actually run; the pure-XLA paths keep the safety check.
+    # will actually run; the pure-XLA paths (incl. impl="naive" at any
+    # size) keep the safety check.
     sp = mesh.shape[axis_name]
-    pallas_blocks = _use_pallas_blocks(q.shape[1] // sp, k.shape[1] // sp)
+    pallas_blocks = impl == "flash" and _use_pallas_blocks(
+        q.shape[1] // sp, k.shape[1] // sp
+    )
     return shard_map(
         fn,
         mesh=mesh,

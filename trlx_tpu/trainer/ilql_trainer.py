@@ -233,9 +233,8 @@ class ILQLTrainer(BaseRLTrainer):
         # --- advantage-shifted sampler (`ilql_models.py:257-327`) ---
         def sample_apply(bundle, input_ids, attention_mask=None, position_ids=None,
                          cache=None, cache_index=None, last_only=False):
-            # last_only (prefill) is accepted but not specialized: the
-            # advantage shift needs per-position Q/V heads anyway; the
-            # sampler only reads the final position either way.
+            # last_only (prefill): logits + Q/V heads only at the final
+            # position — the advantage-shifted decode reads one row.
             out = self.model.apply(
                 {"params": bundle["params"]},
                 input_ids,
@@ -243,6 +242,7 @@ class ILQLTrainer(BaseRLTrainer):
                 position_ids=position_ids,
                 cache=cache,
                 cache_index=cache_index,
+                last_only=last_only,
             )
             target_qs = self.model.apply(
                 {"params": {"heads": bundle["target"]}},
@@ -255,7 +255,8 @@ class ILQLTrainer(BaseRLTrainer):
             adv = minq - out["vs"][..., None]
             logits = jax.nn.log_softmax(out["logits"], axis=-1) + self.beta * adv
             if logit_mask is not None:
-                allowed = logit_mask[input_ids]  # [B, T, V] bool
+                ids = input_ids[:, -1:] if last_only else input_ids
+                allowed = logit_mask[ids]  # [B, T or 1, V] bool
                 logits = jnp.where(allowed, logits, -1e9)
             return {"logits": logits, "cache": out["cache"]}
 
