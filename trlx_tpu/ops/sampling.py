@@ -65,6 +65,27 @@ class SampleOutput:
     values: jax.Array  # value-head estimates at each decision point
 
 
+def validate_gen_config(cfg: GenerationConfig, vocab_size) -> None:
+    """Fail loudly on token ids outside the model's vocab — an out-of-range
+    ``forced_bos_token_id`` (e.g. the UL2 fork's Chinese BOS 21128 against a
+    small from-scratch vocab) otherwise surfaces as NaNs deep in generation.
+    No-op when the model config exposes no vocab size.
+    """
+    if not vocab_size:
+        return
+    for name in ("eos_token_id", "pad_token_id", "forced_bos_token_id",
+                 "decoder_start_token_id"):
+        tid = getattr(cfg, name)
+        if tid is None or tid < 0:
+            continue
+        if tid >= vocab_size:
+            raise ValueError(
+                f"gen_kwargs {name}={tid} is outside the model vocab "
+                f"(vocab_size={vocab_size}) — check that the generation "
+                f"config matches the checkpoint/arch"
+            )
+
+
 def filter_logits(logits: jax.Array, cfg: GenerationConfig) -> jax.Array:
     """Temperature / top-k / top-p filtering (float32 in, float32 out)."""
     if cfg.temperature != 1.0:

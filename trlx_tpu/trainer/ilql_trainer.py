@@ -30,7 +30,7 @@ from trlx_tpu.data.ilql_types import ILQLBatch
 from trlx_tpu.models.heads import CausalLMWithILQLHeads
 from trlx_tpu.models.registry import num_layers_of
 from trlx_tpu.ops.ilql_math import ILQLConfig, ilql_loss, polyak_update
-from trlx_tpu.ops.sampling import GenerationConfig, make_sampler
+from trlx_tpu.ops.sampling import GenerationConfig, make_sampler, validate_gen_config
 from trlx_tpu.parallel import (
     batch_sharding,
     make_partition_specs,
@@ -97,10 +97,15 @@ class ILQLTrainer(BaseRLTrainer):
             gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
             gen_kwargs.setdefault(
                 "pad_token_id",
-                self.tokenizer.pad_token_id or self.tokenizer.eos_token_id,
+                self.tokenizer.pad_token_id
+                if self.tokenizer.pad_token_id is not None
+                else self.tokenizer.eos_token_id,
             )
         gen_kwargs.update(getattr(method, "gen_kwargs", {}) or {})
         self.gen_config = GenerationConfig.from_dict(gen_kwargs)
+        validate_gen_config(
+            self.gen_config, getattr(self.model_config, "vocab_size", None)
+        )
         self.beta = float(method.betas[0])
         self.query_length = min(
             train.seq_length, max(train.seq_length - self.gen_config.max_new_tokens, 1)

@@ -47,7 +47,12 @@ from trlx_tpu.ops.ppo_math import (
     kl_controller_update,
     ppo_loss,
 )
-from trlx_tpu.ops.sampling import GenerationConfig, SampleOutput, make_sampler
+from trlx_tpu.ops.sampling import (
+    GenerationConfig,
+    SampleOutput,
+    make_sampler,
+    validate_gen_config,
+)
 from trlx_tpu.parallel import (
     batch_sharding,
     logprobs_from_logits,
@@ -130,11 +135,16 @@ class PPOTrainer(BaseRLTrainer):
             gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
             gen_kwargs.setdefault(
                 "pad_token_id",
-                self.tokenizer.pad_token_id or self.tokenizer.eos_token_id,
+                self.tokenizer.pad_token_id
+                if self.tokenizer.pad_token_id is not None
+                else self.tokenizer.eos_token_id,
             )
         self._amend_gen_kwargs(gen_kwargs)
         self.gen_config = GenerationConfig.from_dict(gen_kwargs)
         self.query_length = train.seq_length
+        validate_gen_config(
+            self.gen_config, getattr(self.model_config, "vocab_size", None)
+        )
 
         # --- params, shardings, optimizer, state ---
         self.rng, init_rng = jax.random.split(self.rng)
