@@ -65,16 +65,21 @@ class SampleOutput:
     values: jax.Array  # value-head estimates at each decision point
 
 
-def validate_gen_config(cfg: GenerationConfig, vocab_size) -> None:
+def validate_gen_config(cfg: GenerationConfig, vocab_size, provided=None) -> None:
     """Fail loudly on token ids outside the model's vocab — an out-of-range
     ``forced_bos_token_id`` (e.g. the UL2 fork's Chinese BOS 21128 against a
     small from-scratch vocab) otherwise surfaces as NaNs deep in generation.
-    No-op when the model config exposes no vocab size.
+    No-op when the model config exposes no vocab size. When ``provided`` is
+    given (the keys the user/tokenizer actually set), only those fields are
+    checked — dataclass defaults (gpt2's eos 50256) must not crash a
+    small-vocab from-scratch config that never set them.
     """
     if not vocab_size:
         return
     for name in ("eos_token_id", "pad_token_id", "forced_bos_token_id",
                  "decoder_start_token_id"):
+        if provided is not None and name not in provided:
+            continue
         tid = getattr(cfg, name)
         if tid is None or tid < 0:
             continue

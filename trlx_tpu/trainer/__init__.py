@@ -14,6 +14,7 @@ import sys
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
+import jax
 import numpy as np
 
 from trlx_tpu.data.configs import TRLConfig
@@ -96,10 +97,30 @@ class BaseRLTrainer(ABC):
 
     # --- shared host-side text boundary -------------------------------- #
 
+    def apply_tokenizer_gen_defaults(self, gen_kwargs: Dict[str, Any]) -> None:
+        """Default eos/pad from the tokenizer when the config didn't set them
+        (reference wires tokenizer ids into generate kwargs,
+        `accelerate_ppo_model.py:50-54`). pad falls back to eos when the
+        tokenizer has none; a pad id of 0 is preserved (is-not-None check)."""
+        if self.tokenizer is None:
+            return
+        gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
+        gen_kwargs.setdefault(
+            "pad_token_id",
+            self.tokenizer.pad_token_id
+            if self.tokenizer.pad_token_id is not None
+            else self.tokenizer.eos_token_id,
+        )
+
     def decode_responses(self, tokens, response_mask) -> List[str]:
-        """Detokenize responses, truncated at their mask (host boundary)."""
-        tokens = np.asarray(tokens)
-        lengths = np.asarray(response_mask).sum(axis=1)
+        """Detokenize responses, truncated at their mask (host boundary).
+
+        Both arrays come back in ONE transfer event: on a tunneled TPU a
+        device->host fetch costs a flat ~100ms regardless of size, so two
+        separate ``np.asarray`` calls would double the host-boundary tax
+        (SURVEY §7.3)."""
+        tokens, response_mask = jax.device_get((tokens, response_mask))
+        lengths = response_mask.sum(axis=1)
         out = []
         for row, n in zip(tokens, lengths):
             ids = row[: int(n)].tolist()
@@ -110,10 +131,10 @@ class BaseRLTrainer(ABC):
         return out
 
     def decode_queries(self, q_ids, q_mask) -> List[str]:
-        q_ids, q_mask = np.asarray(q_ids), np.asarray(q_mask)
+        q_ids, q_mask = jax.device_get((q_ids, q_mask))
         out = []
         for row, m in zip(q_ids, q_mask):
-            ids = row[m.astype(bool)].tolist()
+            ids = row[np.asarray(m, bool)].tolist()
             if self.tokenizer is not None:
                 out.append(self.tokenizer.decode(ids, skip_special_tokens=True))
             else:

@@ -93,18 +93,13 @@ class ILQLTrainer(BaseRLTrainer):
         )
 
         gen_kwargs = {"max_new_tokens": 48, "do_sample": True, "top_k": 20}
-        if self.tokenizer is not None:
-            gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
-            gen_kwargs.setdefault(
-                "pad_token_id",
-                self.tokenizer.pad_token_id
-                if self.tokenizer.pad_token_id is not None
-                else self.tokenizer.eos_token_id,
-            )
+        self.apply_tokenizer_gen_defaults(gen_kwargs)
         gen_kwargs.update(getattr(method, "gen_kwargs", {}) or {})
         self.gen_config = GenerationConfig.from_dict(gen_kwargs)
         validate_gen_config(
-            self.gen_config, getattr(self.model_config, "vocab_size", None)
+            self.gen_config,
+            getattr(self.model_config, "vocab_size", None),
+            provided=set(gen_kwargs),
         )
         self.beta = float(method.betas[0])
         self.query_length = min(
@@ -359,7 +354,8 @@ class ILQLTrainer(BaseRLTrainer):
                 row += k
                 self.state, stacked = self._train_chunk_jit(self.state, mbs)
                 chunk_time = clock.tick(train.batch_size) / 1000.0
-                rows = {key: np.asarray(v) for key, v in stacked.items()}
+                # one transfer event for the whole stacked stats tree
+                rows = jax.device_get(stacked)
                 for j in range(k):
                     iter_count += 1
                     step_stats = {key: float(v[j]) for key, v in rows.items()}

@@ -131,19 +131,14 @@ class PPOTrainer(BaseRLTrainer):
         init_params = self._setup_model()
 
         gen_kwargs = dict(method.gen_kwargs)
-        if self.tokenizer is not None:
-            gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
-            gen_kwargs.setdefault(
-                "pad_token_id",
-                self.tokenizer.pad_token_id
-                if self.tokenizer.pad_token_id is not None
-                else self.tokenizer.eos_token_id,
-            )
+        self.apply_tokenizer_gen_defaults(gen_kwargs)
         self._amend_gen_kwargs(gen_kwargs)
         self.gen_config = GenerationConfig.from_dict(gen_kwargs)
         self.query_length = train.seq_length
         validate_gen_config(
-            self.gen_config, getattr(self.model_config, "vocab_size", None)
+            self.gen_config,
+            getattr(self.model_config, "vocab_size", None),
+            provided=set(gen_kwargs),
         )
 
         # --- params, shardings, optimizer, state ---
@@ -440,7 +435,11 @@ class PPOTrainer(BaseRLTrainer):
             jnp.asarray(scores, jnp.float32),
             jnp.asarray(self.kl_coef, jnp.float32),
         )
-        self.mean_kl = float(mean_kl)
+        # Keep the rollout KL as a device scalar: pulling it to host here
+        # would cost a full transfer round-trip per chunk (~100ms on a
+        # tunneled chip). Consumers (KL controller, stats logging) operate
+        # on it lazily; Logger.log batches the eventual fetch.
+        self.mean_kl = mean_kl
         return rewards
 
     def train_on_buffer(
@@ -551,7 +550,12 @@ class PPOTrainer(BaseRLTrainer):
             if fused_ok:
                 _, stacked, kl_seq = self.train_on_buffer(seed=train.seed + epoch)
                 phase_time = clock.tick(train.batch_size) / 1000.0
-                rows = {k: np.asarray(v) for k, v in stacked.items()}
+                # one transfer event for the whole stacked stats tree + KL
+                # state (per-key np.asarray would pay ~100ms per leaf on a
+                # tunneled chip)
+                rows, kl_seq, mean_kl = jax.device_get(
+                    (stacked, kl_seq, self.mean_kl)
+                )
                 step_stats = {}
                 for k in range(n_minibatches):
                     iter_count += method.ppo_epochs
@@ -559,8 +563,8 @@ class PPOTrainer(BaseRLTrainer):
                     row = k * method.ppo_epochs + method.ppo_epochs - 1
                     step_stats = {key: float(v[row]) for key, v in rows.items()}
                     step_stats["time/batch"] = phase_time / n_minibatches
-                    step_stats["policy/kl_coef"] = kl_seq[k + 1]
-                    step_stats["policy/mean_rollout_kl"] = self.mean_kl
+                    step_stats["policy/kl_coef"] = float(kl_seq[k + 1])
+                    step_stats["policy/mean_rollout_kl"] = float(mean_kl)
                     if iter_count % train.log_interval == 0:
                         logger.log(step_stats, step=iter_count)
                         final_stats = dict(step_stats)
@@ -595,11 +599,10 @@ class PPOTrainer(BaseRLTrainer):
                     iter_count += 1
                 step_stats["time/batch"] = clock.tick(train.batch_size) / 1000.0
                 # adaptive KL controller (post_backward_callback,
-                # `accelerate_ppo_model.py:136-137`)
-                self.kl_coef = float(
-                    kl_controller_update(
-                        method, self.kl_coef, self.mean_kl, train.batch_size
-                    )
+                # `accelerate_ppo_model.py:136-137`) — stays device-side;
+                # the do_log branch fetches everything in one event
+                self.kl_coef = kl_controller_update(
+                    method, self.kl_coef, self.mean_kl, train.batch_size
                 )
                 step_stats["policy/kl_coef"] = self.kl_coef
                 step_stats["policy/mean_rollout_kl"] = self.mean_kl
@@ -611,6 +614,7 @@ class PPOTrainer(BaseRLTrainer):
 
                 iv = self.intervals(iter_count)
                 if iv["do_log"]:
+                    step_stats = jax.device_get(step_stats)
                     logger.log(step_stats, step=iter_count)
                     final_stats = {k: float(v) for k, v in step_stats.items()}
                 if iv["do_eval"]:
@@ -645,10 +649,11 @@ class PPOTrainer(BaseRLTrainer):
 
     def save(self, directory: Optional[str] = None) -> None:
         directory = directory or self.config.train.checkpoint_dir
+        kl_coef, mean_kl = jax.device_get((self.kl_coef, self.mean_kl))
         save_checkpoint(
             directory,
             self.state,
-            metadata={"kl_coef": self.kl_coef, "mean_kl": self.mean_kl},
+            metadata={"kl_coef": float(kl_coef), "mean_kl": float(mean_kl)},
         )
 
     def load(self, directory: str) -> None:

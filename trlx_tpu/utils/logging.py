@@ -51,6 +51,13 @@ class Logger:
                 self._wandb = None
 
     def log(self, stats: Dict[str, Any], step: Optional[int] = None) -> None:
+        import jax
+
+        # pull any device scalars in ONE transfer event — per-value float()
+        # conversions each cost a full round-trip on a tunneled chip
+        device_vals = {k: v for k, v in stats.items() if isinstance(v, jax.Array)}
+        if device_vals:
+            stats = {**stats, **jax.device_get(device_vals)}
         scalars = filter_non_scalars(stats)
         record = {"step": step, "time": round(time.time() - self.start, 2), **scalars}
         print(json.dumps(record, default=float), file=self.stream, flush=True)
