@@ -70,6 +70,25 @@ class PPOOrchestrator(Orchestrator):
             samples=samples, queries=queries, response_gt=response_gt
         )
 
+    def _dispatch_chunk(self):
+        """Enqueue one chunk's device work (sampler + frozen-ref forward)
+        without waiting on it. Dispatch is async; the results are consumed
+        later, after the *previous* chunk's host-side scoring."""
+        batch, meta = next(self._loader)
+        t = Clock()
+        sample_out = self.trainer.sample(batch.input_ids, batch.attention_mask)
+        dispatch_ms = t.tick()
+        # Frozen-reference forward queued right behind generation
+        # (SURVEY §7.3 — "call out + re-insert scores without stalling
+        # the TPU"): it runs on device while Python scores the batch.
+        ref_logprobs = self.trainer.score_ref(
+            batch.input_ids,
+            batch.attention_mask,
+            sample_out.tokens,
+            sample_out.response_mask,
+        )
+        return batch, meta, sample_out, ref_logprobs, dispatch_ms
+
     def make_experience(self, num_rollouts: int = 128, iter_count: int = 0):
         method: PPOConfig = self.trainer.config.method
         clock = Clock()
@@ -79,23 +98,18 @@ class PPOOrchestrator(Orchestrator):
         score_time = 0.0
         all_scores = []
 
+        # Double-buffered collection: chunk k+1's device work is enqueued
+        # before chunk k's host-side detokenize + reward run, so the device
+        # never idles between chunks. All chunks sample from the same policy
+        # params (no update happens inside a collection phase), so the
+        # pipelining is exactly on-policy — same semantics as the
+        # reference's sequential loop (`ppo_orchestrator.py:66-196`).
+        pending = self._dispatch_chunk()
         while collected < num_rollouts:
-            batch, meta = next(self._loader)
-
-            t = Clock()
-            sample_out = self.trainer.sample(batch.input_ids, batch.attention_mask)
-            generate_time += t.tick() / 1000.0
-
-            # Dispatch the frozen-reference forward *before* the host-side
-            # detokenize + reward call: the device computes ref logprobs
-            # while Python scores the batch (SURVEY §7.3 — "call out +
-            # re-insert scores without stalling the TPU").
-            ref_logprobs = self.trainer.score_ref(
-                batch.input_ids,
-                batch.attention_mask,
-                sample_out.tokens,
-                sample_out.response_mask,
-            )
+            batch, meta, sample_out, ref_logprobs, dispatch_ms = pending
+            generate_time += dispatch_ms / 1000.0
+            if collected + len(batch.input_ids) < num_rollouts:
+                pending = self._dispatch_chunk()
 
             texts = self.trainer.decode_responses(
                 sample_out.tokens, sample_out.response_mask
