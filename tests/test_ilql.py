@@ -85,6 +85,19 @@ def test_ilql_loss_matches_numpy():
     )
     np.testing.assert_allclose(float(loss), expected, rtol=1e-4)
 
+    # single-Q variant (`two_qs: false` — reference ilql_models.py:127-130:
+    # one q head, min over a singleton)
+    cfg1 = ILQLConfig(tau=0.7, gamma=0.9, cql_scale=0.1, awac_scale=1.0, two_qs=False)
+    loss1, _ = ilql_loss(
+        jnp.asarray(logits), (jnp.asarray(qs[0]),), (jnp.asarray(tqs[0]),),
+        jnp.asarray(vs), batch, cfg1,
+    )
+    expected1 = numpy_ilql_loss(
+        logits, qs[:1], tqs[:1], vs, batch_np,
+        {"tau": 0.7, "gamma": 0.9, "cql_scale": 0.1, "awac_scale": 1.0},
+    )
+    np.testing.assert_allclose(float(loss1), expected1, rtol=1e-4)
+
 
 def test_polyak_update():
     import jax.numpy as jnp

@@ -1,0 +1,165 @@
+"""PPOOrchestrator unit tests: reward scaling/seeding semantics and the
+double-buffered collection loop (reference `ppo_orchestrator.py:96-112`,
+first-batch ref-stat seeding `:97-98`, chunked loop `:66-196`)."""
+
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.orchestrator.ppo_orchestrator import PPOOrchestrator
+
+
+class StubBatch:
+    def __init__(self, n, q):
+        self.input_ids = np.zeros((n, q), np.int32)
+        self.attention_mask = np.ones((n, q), np.int32)
+
+    def __len__(self):
+        return len(self.input_ids)
+
+
+class StubSample:
+    def __init__(self, n, r):
+        self.tokens = np.zeros((n, r), np.int32)
+        self.response_mask = np.ones((n, r), np.int32)
+        self.logprobs = np.zeros((n, r), np.float32)
+        self.values = np.zeros((n, r), np.float32)
+
+
+class StubPipeline:
+    def __init__(self, n, chunk):
+        self.n, self.chunk = n, chunk
+
+    def create_loader(self, batch_size, **kw):
+        def gen():
+            for _ in range(self.n // self.chunk):
+                yield StubBatch(self.chunk, 8), {
+                    "prompts_text": ["q"] * self.chunk,
+                    "response_gt": None,
+                    "n_real": self.chunk,
+                }
+
+        return gen()
+
+
+class StubTrainer:
+    """Records the scaled scores handed to compute_rewards."""
+
+    def __init__(self, config):
+        self.config = config
+        self.mean_kl = 0.0
+        self.seen_scores = []
+        self.pushed = 0
+        self.logger = None
+
+    def sample(self, ids, mask):
+        return StubSample(len(ids), 4)
+
+    def score_ref(self, q_ids, q_mask, r_ids, r_mask):
+        return np.zeros((len(q_ids), 4), np.float32)
+
+    def decode_responses(self, tokens, mask):
+        return ["r"] * len(tokens)
+
+    def decode_queries(self, ids, mask):
+        return ["q"] * len(ids)
+
+    def compute_rewards(self, logprobs, ref_logprobs, response_mask, scores):
+        self.seen_scores.append(np.asarray(scores, np.float32).copy())
+        return np.zeros_like(logprobs)
+
+    class _Buffer:
+        def __init__(self, outer):
+            self.outer = outer
+
+        def push(self, batch):
+            self.outer.pushed += len(batch.query_tokens)
+
+    @property
+    def buffer(self):
+        return StubTrainer._Buffer(self)
+
+
+def make_config(scale_reward, ref_mean=None, ref_std=None, cliprange_reward=0.0):
+    return TRLConfig.from_dict(
+        {
+            "model": {"model_type": "gpt2", "model_arch": {"vocab_size": 16}},
+            "train": {"seq_length": 8, "batch_size": 4},
+            "method": {
+                "name": "PPOConfig",
+                "scale_reward": scale_reward,
+                "ref_mean": ref_mean,
+                "ref_std": ref_std,
+                "cliprange_reward": cliprange_reward,
+                "gen_kwargs": {"max_new_tokens": 4},
+            },
+        }
+    )
+
+
+def collect(config, reward_values, n=8, chunk=4):
+    trainer = StubTrainer(config)
+    pipeline = StubPipeline(n=64, chunk=chunk)
+    it = iter(list(reward_values))
+
+    def reward_fn(samples, queries, response_gt=None):
+        v = next(it)
+        return [v] * len(samples)
+
+    orch = PPOOrchestrator(trainer, pipeline, reward_fn=reward_fn, chunk_size=chunk)
+    orch.make_experience(num_rollouts=n, iter_count=0)
+    return trainer, orch
+
+
+def test_ref_stats_seeded_from_first_batch():
+    """scale_reward='ref' with no configured stats uses the first rollout
+    batch's std, as the reference does (`ppo_orchestrator.py:97-98`)."""
+    config = make_config("ref")
+    trainer, orch = collect(config, [2.0, 6.0])
+    # first chunk: all scores equal -> std 0 -> no scaling (guard)
+    np.testing.assert_allclose(trainer.seen_scores[0], 2.0)
+    assert orch.ref_mean == 2.0 and orch.ref_std == 0.0
+
+
+def test_ref_scaling_with_configured_stats():
+    config = make_config("ref", ref_mean=1.0, ref_std=4.0)
+    trainer, _ = collect(config, [2.0, 6.0])
+    np.testing.assert_allclose(trainer.seen_scores[0], 0.5)
+    np.testing.assert_allclose(trainer.seen_scores[1], 1.5)
+
+
+def test_running_scaling_divides_by_running_std():
+    config = make_config("running")
+    trainer, orch = collect(config, [0.0, 4.0])
+    # chunk 1: scores all 0, running std 0 -> unscaled
+    np.testing.assert_allclose(trainer.seen_scores[0], 0.0)
+    # chunk 2: running moments now cover {0.0 x4, 4.0 x4}
+    assert orch.running.std > 0
+    np.testing.assert_allclose(
+        trainer.seen_scores[1], 4.0 / orch.running.std, rtol=1e-5
+    )
+
+
+def test_running_moments_advance_even_without_running_mode():
+    """The reference always updates running moments (`:99`), regardless of
+    the scale mode — they feed the logged stats."""
+    config = make_config("none")
+    trainer, orch = collect(config, [1.0, 3.0])
+    assert orch.running.std > 0
+    # scores untouched
+    np.testing.assert_allclose(trainer.seen_scores[0], 1.0)
+    np.testing.assert_allclose(trainer.seen_scores[1], 3.0)
+
+
+def test_reward_clipping():
+    config = make_config("none", cliprange_reward=0.5)
+    trainer, _ = collect(config, [2.0, -3.0])
+    np.testing.assert_allclose(trainer.seen_scores[0], 0.5)
+    np.testing.assert_allclose(trainer.seen_scores[1], -0.5)
+
+
+def test_collects_exactly_num_rollouts_in_chunks():
+    config = make_config("none")
+    trainer, _ = collect(config, [1.0] * 4, n=12, chunk=4)
+    assert trainer.pushed == 12
+    assert len(trainer.seen_scores) == 3

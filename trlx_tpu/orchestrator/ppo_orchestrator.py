@@ -128,9 +128,13 @@ class PPOOrchestrator(Orchestrator):
             score_time += t.tick() / 1000.0
             all_scores.append(scores.copy())
 
-            # reward scaling + clip (`ppo_orchestrator.py:96-112`)
+            # reward scaling + clip (`ppo_orchestrator.py:96-112`). The
+            # reference seeds ref stats from the first rollout batch when
+            # unset (`:97-98`) and always advances the running moments.
+            if self.ref_mean is None:
+                self.ref_mean, self.ref_std = float(scores.mean()), float(scores.std())
+            self.running.update(scores)
             if method.scale_reward == "running":
-                self.running.update(scores)
                 if self.running.std > 0:
                     scores = scores / self.running.std
             elif method.scale_reward == "ref" and self.ref_std:
@@ -169,6 +173,8 @@ class PPOOrchestrator(Orchestrator):
                 "exp/experience_time": exp_time,
                 "exp/score_mean": float(scores_cat.mean()),
                 "exp/score_std": float(scores_cat.std()),
+                "exp/running_mean": float(self.running.mean),
+                "exp/running_std": float(self.running.std),
                 "exp/rollouts_per_sec": collected / max(exp_time, 1e-9),
                 "policy/mean_rollout_kl": self.trainer.mean_kl,
             }

@@ -167,7 +167,12 @@ def run_local_sweep(
             params = dict(combo)
             params.update({k: p.sample(rng) for k, p in rand_params.items()})
             result = trainable(dict(params)) or {}
+            # a trainable may return per-step history under "history"
+            # (replayed into wandb line plots, `wandb_report.log_trials`)
+            history = result.pop("history", None) if isinstance(result, dict) else None
             record = {"params": params, "result": result}
+            if history:
+                record["history"] = history
             trials.append(record)
             if log_fn:
                 log_fn(f"[sweep] trial {len(trials)}: {params} -> "

@@ -25,7 +25,10 @@ def _wandb():
 
 def log_trials(trials: List[Dict[str, Any]], tune_config: Dict[str, Any],
                project: str = "trlx_tpu-sweeps") -> None:
-    """One wandb run per trial, config = params, summary = final result."""
+    """One wandb run per trial, config = params, summary = final result.
+    Trials carrying a ``history`` list (per-step stat dicts, the analogue of
+    the reference's per-trial ``result.json`` rows, `ray_tune/wandb.py:47-82`)
+    are replayed step by step so line plots have real curves."""
     wandb = _wandb()
     if wandb is None:
         return
@@ -37,6 +40,8 @@ def log_trials(trials: List[Dict[str, Any]], tune_config: Dict[str, Any],
             reinit=True,
             mode=os.environ.get("WANDB_MODE", "offline"),
         )
+        for row in trial.get("history", ()):
+            run.log(row)
         run.log(trial["result"])
         run.finish()
 
@@ -68,5 +73,22 @@ def create_report(project: str, param_space: Dict[str, Any],
             wb.ScatterPlot(x="created", y=metric),
         ],
     )
-    report.blocks = [pg]
+    # per-metric line plots + best-config block (reference
+    # `ray_tune/wandb.py:85-214`). Line plots only make sense when trials
+    # replayed per-step history — single-point runs render nothing a
+    # scatter doesn't.
+    blocks = [pg]
+    if any(t.get("history") for t in trials):
+        metric_names = sorted(
+            {k for t in trials for row in t.get("history", ()) for k in row}
+        )
+        line_panels = [
+            wb.LinePlot(x="_step", y=[m], smoothing_factor=0.5)
+            for m in [metric, *metric_names][:12]
+        ]
+        blocks.append(
+            wb.PanelGrid(runsets=[wb.Runset(project=project)], panels=line_panels)
+        )
+    blocks.append(wb.MarkdownBlock(text=f"**Best config**\n```\n{best}\n```"))
+    report.blocks = blocks
     report.save()
