@@ -160,3 +160,107 @@ def test_eos_finishes_sequences(tiny_policy):
     assert rmask[0, 0] == 1  # eos token itself is real
     assert (toks[0, 1:] == 0).all()  # pad after finish
     assert (rmask[0, 1:] == 0).all()
+
+
+def _eos_biased_apply(model, eos_id, bias=8.0):
+    """apply_fn wrapper that adds a large constant to the eos logit, so an
+    unsuppressed sampler would finish nearly every sequence at step 0."""
+    import jax.numpy as jnp
+
+    def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
+                 cache=None, cache_index=None):
+        out = dict(model.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            position_ids=position_ids, cache=cache, cache_index=cache_index,
+        ))
+        out["logits"] = out["logits"].at[..., eos_id].add(bias)
+        return out
+
+    return apply_fn
+
+
+def test_min_new_tokens_suppresses_eos(tiny_policy):
+    """With a heavily eos-biased model, min_new_tokens=k must keep every
+    sequence alive through step k-1 and let eos through right after (HF
+    MinLengthLogitsProcessor semantics)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.gpt2 import init_cache
+    from trlx_tpu.ops.sampling import GenerationConfig, make_sampler
+
+    config, model, params = tiny_policy
+    Q, R, B = 4, 6, 8
+    gen = GenerationConfig(
+        max_new_tokens=R, min_new_tokens=3, do_sample=True,
+        eos_token_id=96, pad_token_id=0, top_k=0,
+    )
+    sampler = jax.jit(make_sampler(
+        _eos_biased_apply(model, 96), functools.partial(init_cache, config),
+        gen, Q,
+    ))
+    ids = jnp.ones((B, Q), jnp.int32)
+    mask = jnp.ones((B, Q), jnp.int32)
+    saw_eos_after = False
+    for seed in range(4):
+        toks = np.asarray(
+            sampler(params, ids, mask, jax.random.PRNGKey(seed)).tokens
+        )
+        assert not (toks[:, :3] == 96).any()
+        saw_eos_after |= bool((toks[:, 3:] == 96).any())
+    # the bias makes eos overwhelmingly likely once suppression lifts —
+    # proves suppression was load-bearing, not vacuous
+    assert saw_eos_after
+
+
+def test_min_length_counts_real_prompt_tokens(tiny_policy):
+    """min_length is total (real prompt + generated) per sequence: a 1-token
+    prompt with min_length=4 gets 3 suppressed steps; a 3-token prompt only
+    1 (HF causal semantics, reference randomwalks `min_length: 2`)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.gpt2 import init_cache
+    from trlx_tpu.ops.sampling import GenerationConfig, make_sampler
+
+    config, model, params = tiny_policy
+    Q, R = 4, 6
+    gen = GenerationConfig(
+        max_new_tokens=R, min_length=4, do_sample=True,
+        eos_token_id=96, pad_token_id=0, top_k=0,
+    )
+    sampler = jax.jit(make_sampler(
+        _eos_biased_apply(model, 96), functools.partial(init_cache, config),
+        gen, Q,
+    ))
+    ids = np.zeros((2, Q), np.int32)
+    mask = np.zeros((2, Q), np.int32)
+    ids[0, -1] = 5; mask[0, -1] = 1          # 1 real token
+    ids[1, -3:] = [5, 6, 7]; mask[1, -3:] = 1  # 3 real tokens
+    for seed in range(4):
+        toks = np.asarray(
+            sampler(params, jnp.asarray(ids), jnp.asarray(mask),
+                    jax.random.PRNGKey(seed)).tokens
+        )
+        assert not (toks[0, :3] == 96).any()  # needs 3 generated
+        assert not (toks[1, :1] == 96).any()  # needs 1 generated
+        # row 1 is eos-biased and unsuppressed from step 1 on
+        assert (toks[1, 1:] == 96).any()
+
+
+def test_min_suppression_noop_without_eos(tiny_policy):
+    """eos_token_id=None/-1 (a supported 'disabled' sentinel) must not mask
+    the whole vocab when min_new_tokens is set."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.sampling import GenerationConfig, suppress_eos_before_min
+
+    logits = jnp.zeros((2, 8))
+    for eos in (None, -1):
+        cfg = GenerationConfig(min_new_tokens=3, eos_token_id=eos)
+        out = suppress_eos_before_min(logits, jnp.asarray(0), cfg, jnp.asarray(3))
+        assert bool(jnp.isfinite(out).all())

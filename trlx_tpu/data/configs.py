@@ -174,6 +174,12 @@ class TRLConfig:
                     )
                 merge(sections[k].__dict__, v, updates)
                 updates.add(k)
+            elif "." in k:
+                section_name, _, field = k.partition(".")
+                section = sections.get(section_name)
+                if section is not None and hasattr(section, field):
+                    setattr(section, field, v)
+                    updates.add(k)
             else:
                 for section in sections.values():
                     if hasattr(section, k):

@@ -37,6 +37,15 @@ class GenerationConfig:
     """Static generation parameters (hashable: safe as a jit static arg)."""
 
     max_new_tokens: int = 48
+    # eos suppression (HF MinLengthLogitsProcessor semantics; without it a
+    # policy can collapse into emitting eos immediately — a degenerate local
+    # optimum the reference randomwalks config guards with `min_length: 2`):
+    # - ``min_new_tokens``: suppress eos for the first k decode steps;
+    # - ``min_length``: minimum *total* length — real prompt tokens +
+    #   generated for causal LMs, decoder tokens incl. the start token for
+    #   seq2seq — matching what HF counts for each architecture.
+    min_new_tokens: int = 0
+    min_length: int = 0
     temperature: float = 1.0
     top_k: int = 0  # 0 = disabled
     top_p: float = 1.0  # 1.0 = disabled
@@ -91,6 +100,27 @@ def validate_gen_config(cfg: GenerationConfig, vocab_size, provided=None) -> Non
             )
 
 
+def suppress_eos_before_min(
+    logits: jax.Array,
+    t: jax.Array,
+    cfg: GenerationConfig,
+    min_new: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mask the eos logit while ``t < min_new`` (HF MinLengthLogitsProcessor
+    semantics; applied before top-k/top-p as HF does). ``min_new`` is the
+    per-sequence [B] (or scalar) number of suppressed steps the caller
+    derives from min_new_tokens/min_length; no-op when eos is unset."""
+    if min_new is None or cfg.eos_token_id is None or cfg.eos_token_id < 0:
+        return logits
+    eos_col = (
+        jnp.zeros((logits.shape[-1],), bool).at[cfg.eos_token_id].set(True)
+    )
+    active = jnp.asarray(t < min_new)
+    if active.ndim == 0:
+        active = active[None]
+    return jnp.where(active[:, None] & eos_col[None, :], -jnp.inf, logits)
+
+
 def filter_logits(logits: jax.Array, cfg: GenerationConfig) -> jax.Array:
     """Temperature / top-k / top-p filtering (float32 in, float32 out)."""
     if cfg.temperature != 1.0:
@@ -141,6 +171,15 @@ def make_sampler(
         B = prompt_ids.shape[0]
         n_real = jnp.sum(prompt_mask, axis=-1)  # [B]
 
+        # eos-suppression horizon: min_length counts real prompt tokens +
+        # generated (HF causal semantics)
+        if gen_config.min_new_tokens > 0 or gen_config.min_length > 0:
+            min_new = jnp.maximum(
+                gen_config.min_new_tokens, gen_config.min_length - n_real
+            )
+        else:
+            min_new = None
+
         cache = init_cache_fn(B, cap)
         # prefill: cache validity = prompt mask over slots [0, Q)
         pad_tail = jnp.zeros((B, R), dtype=prompt_mask.dtype)
@@ -172,11 +211,12 @@ def make_sampler(
                 forced = jnp.full((B,), gen_config.forced_bos_token_id, jnp.int32)
             else:
                 forced = None
+            choice_logits = suppress_eos_before_min(logits_last, t, gen_config, min_new)
             if gen_config.do_sample:
-                filtered = filter_logits(logits_last, gen_config)
+                filtered = filter_logits(choice_logits, gen_config)
                 token = jax.random.categorical(key, filtered, axis=-1)
             else:
-                token = jnp.argmax(logits_last, axis=-1)
+                token = jnp.argmax(choice_logits, axis=-1)
             token = token.astype(jnp.int32)
             if forced is not None:
                 token = jnp.where(t == 0, forced, token)
@@ -258,6 +298,14 @@ def make_seq2seq_sampler(
 
     def sampler(params, prompt_ids, prompt_mask, rng) -> SampleOutput:
         B = prompt_ids.shape[0]
+        # min_length counts decoder tokens incl. the start token (HF
+        # encoder-decoder semantics)
+        if gen_config.min_new_tokens > 0 or gen_config.min_length > 0:
+            min_new = jnp.maximum(
+                gen_config.min_new_tokens, gen_config.min_length - 1
+            )
+        else:
+            min_new = None
         encoder_hidden = encode_fn(params, prompt_ids, prompt_mask)
         cross_kv = init_cross_kv_fn(params, encoder_hidden)
         cache = init_cache_fn(B, cap)
@@ -285,11 +333,12 @@ def make_seq2seq_sampler(
             cache, logits_last, value_last, finished, rng = carry
             rng, key = jax.random.split(rng)
 
+            choice_logits = suppress_eos_before_min(logits_last, t, gen_config, min_new)
             if gen_config.do_sample:
-                filtered = filter_logits(logits_last, gen_config)
+                filtered = filter_logits(choice_logits, gen_config)
                 token = jax.random.categorical(key, filtered, axis=-1)
             else:
-                token = jnp.argmax(logits_last, axis=-1)
+                token = jnp.argmax(choice_logits, axis=-1)
             token = token.astype(jnp.int32)
             if gen_config.forced_bos_token_id >= 0:
                 token = jnp.where(

@@ -151,12 +151,18 @@ class BaseRLTrainer(ABC):
 
         clock = Clock()
         all_queries, all_texts, all_gt = [], [], []
+        # dispatch every eval chunk's sampler first (independent programs),
+        # then pull all outputs in ONE transfer event — N fetch round-trips
+        # (~100ms each on a tunneled chip) collapse into one
+        chunks = []
         for batch, meta in self.eval_pipeline.create_loader(
             self.eval_batch_size, shuffle=False, drop_last=False
         ):
-            out = self.sample(batch.input_ids, batch.attention_mask)
+            chunks.append((batch, meta, self.sample(batch.input_ids, batch.attention_mask)))
+        fetched = jax.device_get([(o.tokens, o.response_mask) for _, _, o in chunks])
+        for (batch, meta, _), (tokens, response_mask) in zip(chunks, fetched):
             n_real = meta["n_real"]
-            texts = self.decode_responses(out.tokens, out.response_mask)[:n_real]
+            texts = self.decode_responses(tokens, response_mask)[:n_real]
             if meta["prompts_text"][0] is not None:
                 queries = meta["prompts_text"][:n_real]
             else:
