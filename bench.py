@@ -62,6 +62,9 @@ def main():
                 "scale_reward": "running",
                 "gen_kwargs": {
                     "max_new_tokens": 48,
+                    # fixed-length rollouts, as the reference workload
+                    # (ppo_config.yml: min_length == max_length)
+                    "min_new_tokens": 48,
                     "top_k": 0,
                     "do_sample": True,
                     "eos_token_id": 50256,
