@@ -102,7 +102,7 @@ def main():
     one_phase()  # warmup: compile sampler + fused train phase
     one_phase()  # second warmup: absorbs any donated-buffer relayout retrace
 
-    n_phases = 3
+    n_phases = 5
     start = time.time()
     for _ in range(n_phases):
         one_phase()
