@@ -1,0 +1,103 @@
+"""PPO actually learns: mean reward must rise substantially on a trivially
+learnable task. The reference has no such test (its integration tier is the
+slow randomwalks example, SURVEY §4); this guards the whole RL path — KL
+penalty sign, advantage sign, logprob alignment, optimizer wiring — against
+regressions that leave training "running" but not learning (e.g. the
+eos-collapse failure mode fixed by min_new_tokens)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+@pytest.fixture(scope="module")
+def learned():
+    os.environ["WANDB_DISABLED"] = "1"
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "model_arch": {
+                    "vocab_size": 16,
+                    "n_positions": 16,
+                    "n_embd": 32,
+                    "n_layer": 2,
+                    "n_head": 2,
+                },
+            },
+            "train": {
+                "seq_length": 4,
+                "batch_size": 16,
+                "epochs": 12,
+                "total_steps": 96,
+                "eval_interval": 1000,
+                "checkpoint_interval": 100000,
+                "lr_init": 1.0e-3,
+                "lr_target": 1.0e-3,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "float32",
+                "seed": 7,
+            },
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 64,
+                "chunk_size": 64,
+                "ppo_epochs": 2,
+                "init_kl_coef": 0.001,
+                "scale_reward": None,
+                "gen_kwargs": {
+                    "max_new_tokens": 6,
+                    "min_new_tokens": 6,
+                    "top_k": 0,
+                    "do_sample": True,
+                    "eos_token_id": 14,
+                    "pad_token_id": 15,
+                },
+            },
+        }
+    )
+
+    target = 5
+    phase_means = []
+
+    def reward_fn(samples, queries, response_gt=None):
+        scores = [
+            sum(tok == str(target) for tok in s.split()) / 6.0 for s in samples
+        ]
+        phase_means.append(float(np.mean(scores)))
+        return scores
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 13, size=rng.integers(1, 4))) for _ in range(64)]
+    trainer = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=prompts[:16],
+        config=config,
+    )
+    return trainer, phase_means
+
+
+def test_reward_improves(learned):
+    _, phase_means = learned
+    # rollout-phase means, excluding eval calls (eval batches also hit the
+    # reward fn; rollout phases are the ones with 64 samples... both are
+    # appended, so compare a robust early vs late statistic)
+    early = np.mean(phase_means[:2])
+    late = np.max(phase_means[-4:])
+    # random policy emits the target ~1/14 of steps (~0.07); a learning
+    # policy multiplies that several-fold within 96 updates
+    assert late > early + 0.15, (early, late, phase_means)
+
+
+def test_policy_not_collapsed_to_eos(learned):
+    trainer, _ = learned
+    full = trainer.buffer.full
+    # last collected rollouts still have (min_new_tokens) live tokens
+    assert int(np.asarray(full.response_mask).sum(axis=1).min()) >= 6

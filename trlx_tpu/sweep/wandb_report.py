@@ -81,6 +81,7 @@ def create_report(project: str, param_space: Dict[str, Any],
     if any(t.get("history") for t in trials):
         metric_names = sorted(
             {k for t in trials for row in t.get("history", ()) for k in row}
+            - {metric}
         )
         line_panels = [
             wb.LinePlot(x="_step", y=[m], smoothing_factor=0.5)

@@ -158,8 +158,11 @@ class BaseRLTrainer(ABC):
         for batch, meta in self.eval_pipeline.create_loader(
             self.eval_batch_size, shuffle=False, drop_last=False
         ):
-            chunks.append((batch, meta, self.sample(batch.input_ids, batch.attention_mask)))
-        fetched = jax.device_get([(o.tokens, o.response_mask) for _, _, o in chunks])
+            out = self.sample(batch.input_ids, batch.attention_mask)
+            # keep only what eval consumes — retaining full SampleOutputs
+            # would pin every chunk's logprobs/values on device at once
+            chunks.append((batch, meta, (out.tokens, out.response_mask)))
+        fetched = jax.device_get([arrs for _, _, arrs in chunks])
         for (batch, meta, _), (tokens, response_mask) in zip(chunks, fetched):
             n_real = meta["n_real"]
             texts = self.decode_responses(tokens, response_mask)[:n_real]
