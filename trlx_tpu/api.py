@@ -88,9 +88,26 @@ def train(
 
     elif dataset is not None:
         samples, rewards = dataset
+        samples, rewards = list(samples), list(rewards)
         config = config or TRLConfig.load_yaml(_DEFAULT_ILQL_CONFIG)
         if model_path:
             config.model.model_path = model_path
+        # A reward-labeled dataset means offline ILQL. The method config is
+        # the real discriminator: require it, then swap any leftover online
+        # trainer/orchestrator defaults for the offline pair (recorded back
+        # into the config so run logging stays truthful).
+        from trlx_tpu.ops.ilql_math import ILQLConfig
+
+        if not isinstance(config.method, ILQLConfig):
+            raise ValueError(
+                "`dataset` selects offline ILQL, but the config's method is "
+                f"{type(config.method).__name__} — use an ILQLConfig method "
+                "section (e.g. configs/ilql_sentiments.yml)"
+            )
+        if config.train.trainer == "PPOTrainer":
+            config.train.trainer = "ILQLTrainer"
+        if config.train.orchestrator == "PPOOrchestrator":
+            config.train.orchestrator = "OfflineOrchestrator"
         trainer = get_trainer(config.train.trainer)(
             config,
             metric_fn=metric_fn,
@@ -100,14 +117,14 @@ def train(
         orch = get_orchestrator(config.train.orchestrator)(
             trainer, split_token=split_token
         )
-        orch.make_experience(list(samples), list(rewards))
+        orch.make_experience(samples, rewards)
 
         if eval_prompts is None:
             # derive eval prompts from the samples' prompt portions:
             # str -> itself; (prompt_str, response_str) -> prompt;
             # (token_list, action_start) -> tokens before the first action
             eval_prompts = []
-            for s in list(samples)[:64]:
+            for s in samples[:64]:
                 if isinstance(s, str):
                     eval_prompts.append(s)
                 elif len(s) == 2 and isinstance(s[0], str):
