@@ -15,6 +15,28 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def make_target_reward(phase_means, target=5, length=6):
+    """Reward = fraction of response tokens equal to ``target``; appends each
+    batch's mean to ``phase_means`` for before/after comparison."""
+
+    def reward_fn(samples, queries, response_gt=None):
+        scores = [
+            sum(tok == str(target) for tok in s.split()) / length for s in samples
+        ]
+        phase_means.append(float(np.mean(scores)))
+        return scores
+
+    return reward_fn
+
+
+def assert_reward_improved(phase_means, margin=0.15):
+    """Robust early-vs-late comparison (phase_means mixes rollout and eval
+    batches; max of the tail vs mean of the head tolerates that)."""
+    early = np.mean(phase_means[:2])
+    late = np.max(phase_means[-4:])
+    assert late > early + margin, (early, late, phase_means)
+
+
 @pytest.fixture(scope="module")
 def learned():
     os.environ["WANDB_DISABLED"] = "1"
@@ -65,15 +87,8 @@ def learned():
         }
     )
 
-    target = 5
     phase_means = []
-
-    def reward_fn(samples, queries, response_gt=None):
-        scores = [
-            sum(tok == str(target) for tok in s.split()) / 6.0 for s in samples
-        ]
-        phase_means.append(float(np.mean(scores)))
-        return scores
+    reward_fn = make_target_reward(phase_means)
 
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(1, 13, size=rng.integers(1, 4))) for _ in range(64)]
@@ -86,14 +101,9 @@ def learned():
 
 def test_reward_improves(learned):
     _, phase_means = learned
-    # rollout-phase means, excluding eval calls (eval batches also hit the
-    # reward fn; rollout phases are the ones with 64 samples... both are
-    # appended, so compare a robust early vs late statistic)
-    early = np.mean(phase_means[:2])
-    late = np.max(phase_means[-4:])
     # random policy emits the target ~1/14 of steps (~0.07); a learning
     # policy multiplies that several-fold within 96 updates
-    assert late > early + 0.15, (early, late, phase_means)
+    assert_reward_improved(phase_means)
 
 
 def test_policy_not_collapsed_to_eos(learned):
@@ -182,3 +192,79 @@ def test_ilql_generation_prefers_rewarded_token(ilql_learned):
     # a random 13-token policy emits the target in a 6-token response with
     # p ~ 0.37; the trained advantage-shifted decode should be near-always
     assert hit > 0.8, (hit, responses[:5])
+
+
+@pytest.fixture(scope="module")
+def seq2seq_learned():
+    """Seq2seq PPO on the same trivially learnable preference: the decoder
+    must learn to emit the target token regardless of encoder input."""
+    os.environ["WANDB_DISABLED"] = "1"
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "t5",
+                "model_arch": {
+                    "vocab_size": 16,
+                    "d_model": 32,
+                    "d_kv": 8,
+                    "d_ff": 64,
+                    "num_layers": 2,
+                    "num_decoder_layers": 2,
+                    "num_heads": 2,
+                    "relative_attention_num_buckets": 8,
+                    "relative_attention_max_distance": 16,
+                    "feed_forward_proj": "gated-gelu",
+                    "tie_word_embeddings": False,
+                },
+            },
+            "train": {
+                "seq_length": 4,
+                "batch_size": 16,
+                "epochs": 12,
+                "total_steps": 96,
+                "eval_interval": 1000,
+                "checkpoint_interval": 100000,
+                "lr_init": 1.0e-3,
+                "lr_target": 1.0e-3,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "float32",
+                "trainer": "Seq2SeqPPOTrainer",
+                "seed": 11,
+            },
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 64,
+                "chunk_size": 64,
+                "ppo_epochs": 2,
+                "init_kl_coef": 0.001,
+                "scale_reward": None,
+                "gen_kwargs": {
+                    "max_new_tokens": 6,
+                    "min_new_tokens": 6,
+                    "top_k": 0,
+                    "do_sample": True,
+                    "eos_token_id": 1,
+                    "pad_token_id": 0,
+                    "decoder_start_token_id": 0,
+                },
+            },
+        }
+    )
+
+    phase_means = []
+    reward_fn = make_target_reward(phase_means)
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(2, 14, size=3)) for _ in range(64)]
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=prompts[:16],
+        config=config,
+    )
+    return phase_means
+
+
+def test_seq2seq_reward_improves(seq2seq_learned):
+    assert_reward_improved(seq2seq_learned)

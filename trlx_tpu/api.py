@@ -50,8 +50,17 @@ def train(
     :param response_gt: optional ground-truth responses carried to the
         reward fn (the fork's tsv pairs as a proper argument).
     """
+    from trlx_tpu.ops.ilql_math import ILQLConfig
+
     if reward_fn is not None:
         config = config or TRLConfig.load_yaml(_DEFAULT_PPO_CONFIG)
+        if isinstance(config.method, ILQLConfig):
+            raise ValueError(
+                "`reward_fn` selects online PPO, but the config's method is "
+                "ILQLConfig — use a PPO method section (e.g. "
+                "configs/ppo_sentiments.yml), or pass `dataset` for offline "
+                "ILQL"
+            )
         if model_path:
             config.model.model_path = model_path
         trainer = get_trainer(config.train.trainer)(
@@ -94,19 +103,17 @@ def train(
             config.model.model_path = model_path
         # A reward-labeled dataset means offline ILQL. The method config is
         # the real discriminator: require it, then swap any leftover online
-        # trainer/orchestrator defaults for the offline pair (recorded back
-        # into the config so run logging stays truthful).
-        from trlx_tpu.ops.ilql_math import ILQLConfig
-
+        # trainer/orchestrator (incl. seq2seq PPO variants) for the offline
+        # pair, recorded back into the config so run logging stays truthful.
         if not isinstance(config.method, ILQLConfig):
             raise ValueError(
                 "`dataset` selects offline ILQL, but the config's method is "
                 f"{type(config.method).__name__} — use an ILQLConfig method "
                 "section (e.g. configs/ilql_sentiments.yml)"
             )
-        if config.train.trainer == "PPOTrainer":
+        if config.train.trainer != "ILQLTrainer":
             config.train.trainer = "ILQLTrainer"
-        if config.train.orchestrator == "PPOOrchestrator":
+        if config.train.orchestrator != "OfflineOrchestrator":
             config.train.orchestrator = "OfflineOrchestrator"
         trainer = get_trainer(config.train.trainer)(
             config,
