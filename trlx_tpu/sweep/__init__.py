@@ -232,12 +232,18 @@ def get_scheduler(tune_config: Dict[str, Any]):
     raise ValueError(f"Unknown scheduler: {name!r} (fifo | hyperband | bohb)")
 
 
-def run_ray_sweep(trainable, param_space, tune_config, num_cpus=4, num_gpus=0):
-    """Ray Tune executor (`sweep.py:21-49`); requires ray installed."""
+def run_ray_sweep(trainable, param_space, tune_config, num_cpus=4, num_gpus=0,
+                  server_address=None):
+    """Ray Tune executor (`sweep.py:21-49`); requires ray installed.
+    ``server_address`` connects to a remote cluster via the Ray client
+    (reference `sweep.py:87-90`: ``ray.init("ray://...")``)."""
     import ray
     from ray import tune
 
-    ray.init(ignore_reinit_error=True)
+    if server_address:
+        ray.init(address=f"ray://{server_address}", ignore_reinit_error=True)
+    else:
+        ray.init(ignore_reinit_error=True)
     search_alg = get_search_alg(tune_config)
     # metric/mode go to exactly one place: a pre-configured searcher already
     # carries them, and Ray rejects receiving them twice

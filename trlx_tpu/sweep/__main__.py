@@ -42,6 +42,11 @@ def cli(argv=None):
     parser.add_argument("--config", required=True, help="sweep YAML")
     parser.add_argument("--num-cpus", type=int, default=4)
     parser.add_argument("--num-gpus", type=int, default=0)
+    parser.add_argument(
+        "--server-address",
+        default=None,
+        help="remote Ray cluster address (host:port), connected via ray://",
+    )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--output", default="sweep_results.json", help="trial records output"
@@ -64,11 +69,19 @@ def cli(argv=None):
         try:
             import ray  # noqa: F401
         except ImportError:
+            if args.server_address:
+                raise SystemExit(
+                    "--server-address requires ray to be installed "
+                    "(pip install ray[tune])"
+                )
             use_ray = False
+    elif args.server_address:
+        raise SystemExit("--server-address conflicts with --local")
 
     if use_ray:
         best, results = run_ray_sweep(
-            trainable, param_space, tune_config, args.num_cpus, args.num_gpus
+            trainable, param_space, tune_config, args.num_cpus, args.num_gpus,
+            server_address=args.server_address,
         )
         print(f"best config: {best.config}")
     else:

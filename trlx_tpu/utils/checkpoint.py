@@ -25,10 +25,15 @@ def save_checkpoint(
 ) -> None:
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
+    # Orbax save is a collective: every process participates (each writes
+    # its own shards). Only the JSON sidecar is single-writer.
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(os.path.join(directory, "state"), state, force=True)
-    with open(os.path.join(directory, "host_state.json"), "w") as f:
-        json.dump(metadata or {}, f)
+    from trlx_tpu.parallel.distributed import is_main_process
+
+    if is_main_process():
+        with open(os.path.join(directory, "host_state.json"), "w") as f:
+            json.dump(metadata or {}, f)
 
 
 def load_checkpoint(

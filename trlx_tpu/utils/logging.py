@@ -32,10 +32,17 @@ class Logger:
         self.stream = stream or sys.stdout
         self.start = time.time()
         self._wandb = None
+        # rank-0 gating on multi-host pods (reference gates trackers on
+        # accelerator.is_main_process, `accelerate_base_model.py:78`)
+        from trlx_tpu.parallel.distributed import is_main_process
+
+        self.is_main = is_main_process()
         if use_wandb is None:
-            use_wandb = os.environ.get("debug", "") == "" and os.environ.get(
-                "WANDB_DISABLED", ""
-            ) not in ("1", "true")
+            use_wandb = (
+                self.is_main
+                and os.environ.get("debug", "") == ""
+                and os.environ.get("WANDB_DISABLED", "") not in ("1", "true")
+            )
         if use_wandb:
             try:
                 import wandb
@@ -55,6 +62,8 @@ class Logger:
 
         # pull any device scalars in ONE transfer event — per-value float()
         # conversions each cost a full round-trip on a tunneled chip
+        if not self.is_main:
+            return
         device_vals = {k: v for k, v in stats.items() if isinstance(v, jax.Array)}
         if device_vals:
             stats = {**stats, **jax.device_get(device_vals)}
@@ -67,6 +76,8 @@ class Logger:
     def log_samples(self, rows, columns, step: Optional[int] = None) -> None:
         """Log generated-sample tables (reference wandb Table,
         `accelerate_base_model.py:180-221`); stdout shows the first rows."""
+        if not self.is_main:
+            return
         for row in rows[:4]:
             printable = {c: str(v)[:120] for c, v in zip(columns, row)}
             print(json.dumps({"sample": printable}, default=str), file=self.stream)
