@@ -241,7 +241,9 @@ def run_ray_sweep(trainable, param_space, tune_config, num_cpus=4, num_gpus=0,
     from ray import tune
 
     if server_address:
-        ray.init(address=f"ray://{server_address}", ignore_reinit_error=True)
+        # client mode rejects non-default kwargs like ignore_reinit_error
+        # (the reference likewise calls ray.init("ray://...") bare)
+        ray.init(address=f"ray://{server_address}")
     else:
         ray.init(ignore_reinit_error=True)
     search_alg = get_search_alg(tune_config)
@@ -262,4 +264,9 @@ def run_ray_sweep(trainable, param_space, tune_config, num_cpus=4, num_gpus=0,
         ),
     )
     results = tuner.fit()
-    return results.get_best_result(), results
+    # explicit metric/mode: with a pre-configured searcher TuneConfig
+    # carries neither, and a bare get_best_result() would raise
+    best = results.get_best_result(
+        metric=tune_config["metric"], mode=tune_config["mode"]
+    )
+    return best, results

@@ -195,10 +195,13 @@ class BaseRLTrainer(ABC):
             columns.append("reward")
             table = [row + [float(s)] for row, s in zip(table, scores)]
         if self.metric_fn is not None:
+            metric_clock = Clock()
             metrics = self.metric_fn(all_texts)
             for k, v in metrics.items():
                 v = np.asarray(v, dtype=np.float32)
                 stats[f"metrics/{k}"] = float(v.mean())
+            # reference logs metric_time (`accelerate_base_model.py:202-204`)
+            stats["time/metric"] = metric_clock.tick() / 1000.0
         self._last_samples = (columns, table)
         return stats
 
