@@ -19,9 +19,8 @@ in the rollout loop"):
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
-import jax.numpy as jnp
 import numpy as np
 
 from trlx_tpu.orchestrator import Orchestrator, register_orchestrator

@@ -21,7 +21,6 @@ from typing import Any, Callable, Dict, Optional
 import flax.struct as struct
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -39,7 +38,6 @@ from trlx_tpu.parallel import (
 )
 from trlx_tpu.trainer import BaseRLTrainer, register_trainer
 from trlx_tpu.trainer.common import make_optimizer, unfrozen_param_mask
-from trlx_tpu.trainer.ppo_trainer import get_gpt2_arch
 from trlx_tpu.utils import Clock, set_seed
 from trlx_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 from trlx_tpu.utils.logging import Logger

@@ -39,7 +39,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ppo_types import PPORolloutBatch
-from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model, PARTITION_RULES, init_cache
 from trlx_tpu.models.heads import CausalLMWithValueHead
 from trlx_tpu.ops.ppo_math import (
     PPOConfig,
