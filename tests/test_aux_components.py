@@ -98,3 +98,51 @@ def test_train_funcs_importable():
 
     assert callable(train_funcs.ppo_randomwalks_train)
     assert callable(train_funcs.ppo_sentiments_train)
+
+
+def test_logger_batches_device_scalars():
+    """Logger.log must pull jax scalars (one batched fetch) and render them
+    as plain floats in the JSON record."""
+    import io
+    import json as json_mod
+
+    import jax.numpy as jnp
+
+    from trlx_tpu.utils.logging import Logger
+
+    stream = io.StringIO()
+    logger = Logger(use_wandb=False, stream=stream)
+    logger.log({"a": jnp.asarray(1.5), "b": 2.0, "skip": "text"}, step=3)
+    record = json_mod.loads(stream.getvalue().strip())
+    assert record["a"] == 1.5 and record["b"] == 2.0 and record["step"] == 3
+    assert "skip" not in record
+
+
+def test_tokenizer_gen_defaults_preserve_pad_zero():
+    """A tokenizer with pad_token_id=0 (falsy) must keep pad 0 — not fall
+    back to eos (T5/UL2's pad IS 0)."""
+    from trlx_tpu.trainer import BaseRLTrainer
+
+    class Tok:
+        eos_token_id = 1
+        pad_token_id = 0
+
+    class Host:
+        tokenizer = Tok()
+        apply_tokenizer_gen_defaults = BaseRLTrainer.apply_tokenizer_gen_defaults
+
+    kwargs = {}
+    Host().apply_tokenizer_gen_defaults(kwargs)
+    assert kwargs == {"eos_token_id": 1, "pad_token_id": 0}
+
+    class TokNoPad:
+        eos_token_id = 7
+        pad_token_id = None
+
+    class Host2:
+        tokenizer = TokNoPad()
+        apply_tokenizer_gen_defaults = BaseRLTrainer.apply_tokenizer_gen_defaults
+
+    kwargs = {}
+    Host2().apply_tokenizer_gen_defaults(kwargs)
+    assert kwargs == {"eos_token_id": 7, "pad_token_id": 7}
