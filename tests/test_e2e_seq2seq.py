@@ -121,3 +121,65 @@ def test_ul2_reward_helpers():
     rf = make_reward_fn()
     scores = rf(["你好呀</s>", "xyz"], ["q1", "q2"], ["你好呀", "abc"])
     assert scores[0] > scores[1]
+
+
+def test_seq2seq_bf16_param_storage_trains():
+    """The fork loads the whole T5 in bfloat16 (`ppo_models.py:615`);
+    param_dtype=bfloat16 must train without dtype errors and keep params
+    finite."""
+    import os
+
+    import jax
+    import numpy as np
+
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    os.environ["WANDB_DISABLED"] = "1"
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "t5",
+                "model_arch": {
+                    "vocab_size": 32, "d_model": 32, "d_kv": 8, "d_ff": 64,
+                    "num_layers": 2, "num_decoder_layers": 2, "num_heads": 4,
+                    "relative_attention_num_buckets": 8,
+                    "relative_attention_max_distance": 16,
+                    "feed_forward_proj": "gated-gelu",
+                    "tie_word_embeddings": False,
+                },
+            },
+            "train": {
+                "seq_length": 8, "batch_size": 16, "epochs": 1,
+                "total_steps": 2, "eval_interval": 1000,
+                "checkpoint_interval": 100000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "bfloat16", "param_dtype": "bfloat16",
+                "trainer": "Seq2SeqPPOTrainer",
+            },
+            "method": {
+                "name": "PPOConfig", "num_rollouts": 16, "chunk_size": 16,
+                "ppo_epochs": 1, "init_kl_coef": 0.02,
+                "gen_kwargs": {
+                    "max_new_tokens": 4, "do_sample": True,
+                    "eos_token_id": 1, "pad_token_id": 0,
+                    "decoder_start_token_id": 0,
+                },
+            },
+        }
+    )
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(2, 30, size=4)) for _ in range(16)]
+    trainer = trlx_tpu.train(
+        reward_fn=lambda samples, queries, response_gt=None: [
+            float(len(s)) for s in samples
+        ],
+        prompts=prompts,
+        config=config,
+    )
+    # epochs=1 x 1 minibatch x ppo_epochs=1 -> exactly one update ran
+    assert int(trainer.state.step) == 1
+    leaves = jax.device_get(jax.tree_util.tree_leaves(trainer.state.params))
+    assert all(
+        bool(np.isfinite(np.asarray(l, np.float32)).all()) for l in leaves
+    )

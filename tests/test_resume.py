@@ -112,3 +112,38 @@ def test_fresh_run_ignores_stale_checkpoint(tmp_path):
     # resume flag off: starts from step 0 even though a checkpoint exists
     t2 = _train(_config(tmp_path, total_steps=2, resume=False))
     assert int(t2.state.step) == 2  # trained 2 fresh steps (0 -> 2)
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """async_checkpoint=True: save returns immediately, the background write
+    commits (joined by wait_for_checkpoints / load), and a restore
+    reproduces the params exactly."""
+    import jax
+    import numpy as np
+
+    from trlx_tpu.utils.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+        wait_for_checkpoints,
+    )
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = _config(tmp_path, total_steps=2)
+    config.train.async_checkpoint = True
+    trainer = get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+
+    trainer.save(str(tmp_path / "async_ckpt"))
+    wait_for_checkpoints()
+
+    abstract = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        trainer.state,
+        trainer.state_shardings,
+    )
+    state, meta = load_checkpoint(str(tmp_path / "async_ckpt"), abstract)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(trainer.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert "kl_coef" in meta

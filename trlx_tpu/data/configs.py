@@ -104,6 +104,9 @@ class TrainConfig:
     # restore train state + loop counters from checkpoint_dir before
     # training (reference Ray-resume path, `accelerate_base_model.py:232-240`)
     resume_from_checkpoint: bool = False
+    # write checkpoints on Orbax's background thread: the train loop resumes
+    # as soon as device arrays are snapshotted to host buffers
+    async_checkpoint: bool = False
     project_name: str = "trlx_tpu"
     run_name: str = ""
     seed: int = 1000

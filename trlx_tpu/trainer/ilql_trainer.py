@@ -39,7 +39,11 @@ from trlx_tpu.parallel import (
 from trlx_tpu.trainer import BaseRLTrainer, register_trainer
 from trlx_tpu.trainer.common import make_optimizer, unfrozen_param_mask
 from trlx_tpu.utils import Clock, set_seed
-from trlx_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from trlx_tpu.utils.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+    wait_for_checkpoints,
+)
 from trlx_tpu.utils.logging import Logger
 
 
@@ -316,13 +320,24 @@ class ILQLTrainer(BaseRLTrainer):
             tags=train.tags,
         )
         self.logger = logger
+        try:
+            return self._learn_body(logger, total_steps, n_minibatches)
+        finally:
+            # single epilogue for every exit (incl. exceptions): join
+            # in-flight async checkpoint writes, close the logger
+            wait_for_checkpoints()
+            logger.finish()
+
+    def _learn_body(
+        self, logger: Logger, total_steps: int, n_minibatches: int
+    ) -> Dict[str, Any]:
+        train = self.config.train
         stats = self.evaluate()
         logger.log(stats, step=0)
 
         clock = Clock()
         iter_count = int(self.state.step)  # nonzero after resume
         if iter_count >= total_steps:
-            logger.finish()
             self._final_stats = {}
             return {}
         final_stats: Dict[str, Any] = {}
@@ -373,19 +388,21 @@ class ILQLTrainer(BaseRLTrainer):
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
-                    logger.finish()
                     self._final_stats = final_stats
                     return final_stats
-        logger.finish()
         self._final_stats = final_stats
         return final_stats
 
     def save(self, directory: Optional[str] = None) -> None:
         save_checkpoint(
-            directory or self.config.train.checkpoint_dir, self.state, metadata={}
+            directory or self.config.train.checkpoint_dir,
+            self.state,
+            metadata={},
+            async_save=self.config.train.async_checkpoint,
         )
 
     def load(self, directory: str) -> None:
+        wait_for_checkpoints()  # join any in-flight async write first
         abstract = jax.tree_util.tree_map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             self.state,

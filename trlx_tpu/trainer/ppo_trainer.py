@@ -63,7 +63,11 @@ from trlx_tpu.pipeline.ppo_buffer import PPORolloutBuffer
 from trlx_tpu.trainer import BaseRLTrainer, register_trainer
 from trlx_tpu.trainer.common import TrainState, make_optimizer, unfrozen_param_mask
 from trlx_tpu.utils import Clock, set_seed
-from trlx_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from trlx_tpu.utils.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+    wait_for_checkpoints,
+)
 from trlx_tpu.utils.logging import Logger
 
 
@@ -508,6 +512,24 @@ class PPOTrainer(BaseRLTrainer):
             tags=train.tags,
         )
         self.logger = logger
+        self._profiling = False
+        try:
+            return self._learn_body(logger, total_steps, n_minibatches)
+        finally:
+            # single epilogue for every exit (incl. exceptions): stop any
+            # live profiler trace, join in-flight async checkpoint writes
+            # (surfacing background write errors), close the logger
+            if self._profiling:
+                jax.profiler.stop_trace()
+                self._profiling = False
+            wait_for_checkpoints()
+            logger.finish()
+
+    def _learn_body(
+        self, logger: Logger, total_steps: int, n_minibatches: int
+    ) -> Dict[str, Any]:
+        train = self.config.train
+        method: PPOConfig = self.config.method
 
         stats = self.evaluate()
         logger.log(stats, step=0)
@@ -517,15 +539,13 @@ class PPOTrainer(BaseRLTrainer):
         clock = Clock()
         iter_count = int(self.state.step)  # nonzero after resume
         final_stats: Dict[str, Any] = {}
+        self._final_stats = final_stats
         if iter_count >= total_steps:
             # resumed a finished run: nothing left to train
-            logger.finish()
-            self._final_stats = final_stats
             return final_stats
-        profiling = False
         if train.profile_dir:
             jax.profiler.start_trace(train.profile_dir)
-            profiling = True
+            self._profiling = True
         for epoch in range(train.epochs):
             # Fused path: the whole buffer pass is one device dispatch
             # (lax.scan over minibatches) — used whenever no eval/save
@@ -537,7 +557,7 @@ class PPOTrainer(BaseRLTrainer):
                 for k in range(1, n_minibatches)
             ]
             fused_ok = (
-                not profiling
+                not self._profiling
                 and len(self.buffer) >= train.batch_size
                 and iter_count + pass_steps <= total_steps
                 and not any(
@@ -579,7 +599,6 @@ class PPOTrainer(BaseRLTrainer):
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
-                    logger.finish()
                     self._final_stats = final_stats
                     return final_stats
                 if self.orch is not None and epoch < train.epochs - 1:
@@ -606,10 +625,10 @@ class PPOTrainer(BaseRLTrainer):
                 step_stats["policy/kl_coef"] = self.kl_coef
                 step_stats["policy/mean_rollout_kl"] = self.mean_kl
 
-                if profiling and iter_count >= 10:
+                if self._profiling and iter_count >= 10:
                     jax.block_until_ready(self.state.params)
                     jax.profiler.stop_trace()
-                    profiling = False
+                    self._profiling = False
 
                 iv = self.intervals(iter_count)
                 if iv["do_log"]:
@@ -623,14 +642,10 @@ class PPOTrainer(BaseRLTrainer):
                 if iv["do_save"]:
                     self.save()
                 if iter_count >= total_steps:
-                    if profiling:
-                        jax.profiler.stop_trace()
-                        profiling = False
                     self.save()
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
-                    logger.finish()
                     self._final_stats = final_stats
                     return final_stats
             # on-policy refresh (post_epoch_callback,
@@ -638,9 +653,6 @@ class PPOTrainer(BaseRLTrainer):
             if self.orch is not None and epoch < train.epochs - 1:
                 self.buffer.clear_history()
                 self.orch.make_experience(method.num_rollouts, iter_count)
-        if profiling:
-            jax.profiler.stop_trace()
-        logger.finish()
         self._final_stats = final_stats
         return final_stats
 
@@ -653,9 +665,11 @@ class PPOTrainer(BaseRLTrainer):
             directory,
             self.state,
             metadata={"kl_coef": float(kl_coef), "mean_kl": float(mean_kl)},
+            async_save=self.config.train.async_checkpoint,
         )
 
     def load(self, directory: str) -> None:
+        wait_for_checkpoints()  # join any in-flight async write first
         abstract = jax.tree_util.tree_map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             self.state,

@@ -2,10 +2,17 @@
 
 TPU-native replacement for ``accelerator.save_state/load_state``
 (`accelerate_base_model.py:144-146`, SURVEY §5.4): the whole train state
-(params, optimizer state, step) is one pytree saved via Orbax — sharded
-arrays are written/restored per-shard without host gathering — plus a JSON
-sidecar for host-side loop state (iter count, KL coefficient, RNG seed),
-mirroring the reference's Ray `state.json` (`accelerate_base_model.py:232-240`).
+(params, optimizer state, step) and the host-side loop metadata (KL
+coefficient, rollout KL) are saved as ONE composite Orbax checkpoint —
+sharded arrays are written/restored per-shard without host gathering, and
+the state+metadata pair commits atomically (no torn sidecar on a crash
+mid-write), mirroring what the reference's Ray `state.json`
+(`accelerate_base_model.py:232-240`) records.
+
+``async_save=True`` returns once device arrays are snapshotted to host
+buffers; the filesystem write proceeds on Orbax's background thread
+(SURVEY §5.4 "Orbax async checkpointing"). :func:`wait_for_checkpoints`
+joins any in-flight write and surfaces background write errors.
 """
 
 from __future__ import annotations
@@ -14,39 +21,77 @@ import json
 import os
 from typing import Any, Dict, Optional, Tuple
 
-import jax
 import orbax.checkpoint as ocp
+
+# Long-lived async checkpointer: it owns a background thread pool and
+# (multi-host) a coordination barrier, so it must not be per-call.
+_async_ckptr: Optional[ocp.AsyncCheckpointer] = None
+
+
+def _composite_handler():
+    return ocp.CompositeCheckpointHandler()
+
+
+def _get_async_ckptr() -> ocp.AsyncCheckpointer:
+    global _async_ckptr
+    if _async_ckptr is None:
+        _async_ckptr = ocp.AsyncCheckpointer(_composite_handler())
+    return _async_ckptr
+
+
+def _save_args(state: Any, metadata: Optional[Dict[str, Any]]):
+    return ocp.args.Composite(
+        state=ocp.args.StandardSave(state),
+        host_state=ocp.args.JsonSave(metadata or {}),
+    )
 
 
 def save_checkpoint(
     directory: str,
     state: Any,
     metadata: Optional[Dict[str, Any]] = None,
+    async_save: bool = False,
 ) -> None:
+    """Save state + metadata as one atomically-committed checkpoint."""
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
-    # Orbax save is a collective: every process participates (each writes
-    # its own shards). Only the JSON sidecar is single-writer.
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.join(directory, "state"), state, force=True)
-    from trlx_tpu.parallel.distributed import is_main_process
+    path = os.path.join(directory, "state")
+    if async_save:
+        _get_async_ckptr().save(path, args=_save_args(state, metadata), force=True)
+    else:
+        with ocp.Checkpointer(_composite_handler()) as ckptr:
+            ckptr.save(path, args=_save_args(state, metadata), force=True)
 
-    if is_main_process():
-        with open(os.path.join(directory, "host_state.json"), "w") as f:
-            json.dump(metadata or {}, f)
+
+def wait_for_checkpoints() -> None:
+    """Block until any in-flight async checkpoint write has committed
+    (re-raises background write errors)."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
 
 
 def load_checkpoint(
     directory: str, abstract_state: Any
 ) -> Tuple[Any, Dict[str, Any]]:
     """Restore into the shapes/shardings of ``abstract_state`` (obtain via
-    ``jax.eval_shape`` + shardings, or pass a live state of the right spec)."""
+    ``jax.eval_shape`` + shardings, or pass a live state of the right spec).
+    Reads both the composite layout and the legacy state-dir +
+    host_state.json sidecar layout."""
+    wait_for_checkpoints()
     directory = os.path.abspath(directory)
-    with ocp.StandardCheckpointer() as ckptr:
-        state = ckptr.restore(os.path.join(directory, "state"), abstract_state)
-    meta_path = os.path.join(directory, "host_state.json")
-    metadata: Dict[str, Any] = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            metadata = json.load(f)
-    return state, metadata
+    path = os.path.join(directory, "state")
+    legacy_json = os.path.join(directory, "host_state.json")
+    if os.path.exists(legacy_json):
+        with ocp.StandardCheckpointer() as ckptr:
+            state = ckptr.restore(path, abstract_state)
+        with open(legacy_json) as f:
+            return state, json.load(f)
+    with ocp.Checkpointer(_composite_handler()) as ckptr:
+        restored = ckptr.restore(
+            path,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_state),
+                host_state=ocp.args.JsonRestore(),
+            ),
+        )
+    return restored["state"], dict(restored["host_state"] or {})
