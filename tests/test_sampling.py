@@ -264,3 +264,61 @@ def test_min_suppression_noop_without_eos(tiny_policy):
         cfg = GenerationConfig(min_new_tokens=3, eos_token_id=eos)
         out = suppress_eos_before_min(logits, jnp.asarray(0), cfg, jnp.asarray(3))
         assert bool(jnp.isfinite(out).all())
+
+
+def test_gen_config_accepts_reference_style_kwargs():
+    """Reference YAMLs write `max_length` and float `top_k: 0.0`
+    (configs/ppo_config.yml, ppo_gptj.yml) — from_dict must map/coerce
+    instead of silently dropping."""
+    from trlx_tpu.ops.sampling import GenerationConfig
+
+    gc = GenerationConfig.from_dict(
+        {"max_length": 48, "min_length": 48, "top_k": 0.0, "top_p": 1.0,
+         "do_sample": True}
+    )
+    assert gc.max_new_tokens == 48
+    assert gc.min_length == 48
+    assert gc.top_k == 0 and isinstance(gc.top_k, int)
+    # explicit max_new_tokens wins over max_length
+    gc = GenerationConfig.from_dict({"max_length": 48, "max_new_tokens": 12})
+    assert gc.max_new_tokens == 12
+
+
+def test_max_length_caps_total_length_per_sequence(tiny_policy):
+    """HF max_length counts prompt + generated for causal LMs: a 6-token
+    prompt with max_length=8 gets 2 real response tokens, a 2-token prompt
+    gets 6 (budget-limited), the rest is pad/mask-0."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.gpt2 import init_cache
+    from trlx_tpu.ops.sampling import GenerationConfig, make_sampler
+
+    config, model, params = tiny_policy
+    Q, R = 6, 6
+    gen = GenerationConfig(
+        max_new_tokens=R, max_length=8, do_sample=True,
+        eos_token_id=96, pad_token_id=0, top_k=0,
+    )
+
+    def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
+                 cache=None, cache_index=None):
+        return model.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            position_ids=position_ids, cache=cache, cache_index=cache_index,
+        )
+
+    sampler = jax.jit(make_sampler(
+        apply_fn, functools.partial(init_cache, config), gen, Q
+    ))
+    ids = np.zeros((2, Q), np.int32)
+    mask = np.zeros((2, Q), np.int32)
+    ids[0, -6:] = np.arange(1, 7); mask[0, -6:] = 1   # 6 real tokens
+    ids[1, -2:] = [3, 4]; mask[1, -2:] = 1            # 2 real tokens
+    out = sampler(params, jnp.asarray(ids), jnp.asarray(mask),
+                  jax.random.PRNGKey(0))
+    lens = np.asarray(out.response_mask).sum(axis=1)
+    assert lens[0] <= 2, lens  # 6 + 2 = 8
+    assert lens[1] <= 6, lens  # budget-limited (2 + 6 = 8)

@@ -46,6 +46,11 @@ class GenerationConfig:
     #   seq2seq — matching what HF counts for each architecture.
     min_new_tokens: int = 0
     min_length: int = 0
+    # HF-style total-length cap (prompt + generated for causal; decoder
+    # tokens incl. start for seq2seq): sequences reaching it finish early
+    # even though the compiled decode always runs max_new_tokens steps
+    # (static shapes) — remaining steps emit pad with mask 0.
+    max_length: int = 0
     temperature: float = 1.0
     top_k: int = 0  # 0 = disabled
     top_p: float = 1.0  # 1.0 = disabled
@@ -59,8 +64,22 @@ class GenerationConfig:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "GenerationConfig":
+        d = dict(d)
+        # reference configs write HF's ``max_length`` (their gen budget;
+        # `configs/ppo_config.yml` "LM max sample gen length") — map it to
+        # the decode budget rather than silently dropping it
+        if "max_length" in d and "max_new_tokens" not in d:
+            d["max_new_tokens"] = d["max_length"]
         known = {f.name for f in dataclasses.fields(cls)}
         d = {k: v for k, v in d.items() if k in known}
+        # reference YAMLs write numeric fields as floats (``top_k: 0.0``,
+        # `configs/ppo_gptj.yml`); coerce integral fields
+        for name in ("max_new_tokens", "min_new_tokens", "min_length",
+                     "max_length", "top_k",
+                     "eos_token_id", "pad_token_id", "forced_bos_token_id",
+                     "decoder_start_token_id"):
+            if name in d and d[name] is not None:
+                d[name] = int(d[name])
         return cls(**d)
 
 
@@ -230,6 +249,11 @@ def make_sampler(
             )
             live = jnp.logical_not(finished)
             finished = jnp.logical_or(finished, token == gen_config.eos_token_id)
+            if gen_config.max_length > 0:
+                # HF total-length cap: prompt + generated >= max_length
+                finished = jnp.logical_or(
+                    finished, n_real + t + 1 >= gen_config.max_length
+                )
 
             ys = (token, live.astype(jnp.int32), logprob, value_last)
 
@@ -253,7 +277,11 @@ def make_sampler(
             )
             return (out["cache"], new_logits, new_value, finished, rng), ys
 
-        finished0 = jnp.zeros((B,), bool)
+        if gen_config.max_length > 0:
+            # prompts already at/over the total-length cap emit no tokens
+            finished0 = n_real >= gen_config.max_length
+        else:
+            finished0 = jnp.zeros((B,), bool)
         (_, _, _, _, _), (tokens, mask, logprobs, values) = jax.lax.scan(
             step,
             (cache, logits_last, value_last, finished0, rng),
@@ -354,6 +382,11 @@ def make_seq2seq_sampler(
             )
             live = jnp.logical_not(finished)
             finished = jnp.logical_or(finished, token == gen_config.eos_token_id)
+            if gen_config.max_length > 0:
+                # decoder tokens incl. the start token: (t+1 generated) + 1
+                finished = jnp.logical_or(
+                    finished, t + 2 >= gen_config.max_length
+                )
             ys = (token, live.astype(jnp.int32), logprob, value_last)
 
             dec_mask = (slot_ids <= t + 1).astype(jnp.int32).repeat(B, 0)
@@ -374,7 +407,10 @@ def make_seq2seq_sampler(
             )
             return (out["cache"], new_logits, new_value, finished, rng), ys
 
-        finished0 = jnp.zeros((B,), bool)
+        if gen_config.max_length > 0:
+            finished0 = jnp.full((B,), 1 >= gen_config.max_length)
+        else:
+            finished0 = jnp.zeros((B,), bool)
         _, (tokens, mask, logprobs, values) = jax.lax.scan(
             step,
             (cache, logits_last, value_last, finished0, rng),

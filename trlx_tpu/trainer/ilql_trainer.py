@@ -40,6 +40,7 @@ from trlx_tpu.trainer import BaseRLTrainer, register_trainer
 from trlx_tpu.trainer.common import make_optimizer, unfrozen_param_mask
 from trlx_tpu.utils import Clock, set_seed
 from trlx_tpu.utils.checkpoint import (
+    has_checkpoint,
     load_checkpoint,
     save_checkpoint,
     wait_for_checkpoints,
@@ -303,11 +304,7 @@ class ILQLTrainer(BaseRLTrainer):
 
         # resume (reference Ray session restore, `accelerate_base_model.py:
         # 232-240`)
-        import os
-
-        if train.resume_from_checkpoint and os.path.isdir(
-            os.path.join(train.checkpoint_dir, "state")
-        ):
+        if train.resume_from_checkpoint and has_checkpoint(train.checkpoint_dir):
             self.load(train.checkpoint_dir)
 
         n_minibatches = max(len(self.store) // train.batch_size, 1)
@@ -324,9 +321,12 @@ class ILQLTrainer(BaseRLTrainer):
             return self._learn_body(logger, total_steps, n_minibatches)
         finally:
             # single epilogue for every exit (incl. exceptions): join
-            # in-flight async checkpoint writes, close the logger
-            wait_for_checkpoints()
-            logger.finish()
+            # in-flight async checkpoint writes, close the logger even if
+            # that join raises
+            try:
+                wait_for_checkpoints()
+            finally:
+                logger.finish()
 
     def _learn_body(
         self, logger: Logger, total_steps: int, n_minibatches: int
@@ -399,10 +399,10 @@ class ILQLTrainer(BaseRLTrainer):
             self.state,
             metadata={},
             async_save=self.config.train.async_checkpoint,
+            step=int(jax.device_get(self.state.step)),
         )
 
     def load(self, directory: str) -> None:
-        wait_for_checkpoints()  # join any in-flight async write first
         abstract = jax.tree_util.tree_map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             self.state,

@@ -64,6 +64,7 @@ from trlx_tpu.trainer import BaseRLTrainer, register_trainer
 from trlx_tpu.trainer.common import TrainState, make_optimizer, unfrozen_param_mask
 from trlx_tpu.utils import Clock, set_seed
 from trlx_tpu.utils.checkpoint import (
+    has_checkpoint,
     load_checkpoint,
     save_checkpoint,
     wait_for_checkpoints,
@@ -488,9 +489,7 @@ class PPOTrainer(BaseRLTrainer):
         # resume (reference Ray session restore, `accelerate_base_model.py:
         # 232-240`): restore params/opt/step + KL-controller state, continue
         # the step count from the checkpoint
-        if train.resume_from_checkpoint and os.path.isdir(
-            os.path.join(train.checkpoint_dir, "state")
-        ):
+        if train.resume_from_checkpoint and has_checkpoint(train.checkpoint_dir):
             self.load(train.checkpoint_dir)
             if int(self.state.step) >= train.total_steps:
                 # finished run: skip rollout collection entirely
@@ -518,12 +517,17 @@ class PPOTrainer(BaseRLTrainer):
         finally:
             # single epilogue for every exit (incl. exceptions): stop any
             # live profiler trace, join in-flight async checkpoint writes
-            # (surfacing background write errors), close the logger
-            if self._profiling:
-                jax.profiler.stop_trace()
-                self._profiling = False
-            wait_for_checkpoints()
-            logger.finish()
+            # (surfacing background write errors), close the logger even if
+            # that join raises
+            try:
+                if self._profiling:
+                    jax.profiler.stop_trace()
+                    self._profiling = False
+            finally:
+                try:
+                    wait_for_checkpoints()
+                finally:
+                    logger.finish()
 
     def _learn_body(
         self, logger: Logger, total_steps: int, n_minibatches: int
@@ -660,16 +664,19 @@ class PPOTrainer(BaseRLTrainer):
 
     def save(self, directory: Optional[str] = None) -> None:
         directory = directory or self.config.train.checkpoint_dir
-        kl_coef, mean_kl = jax.device_get((self.kl_coef, self.mean_kl))
+        # one batched fetch for all host-side save inputs
+        kl_coef, mean_kl, step = jax.device_get(
+            (self.kl_coef, self.mean_kl, self.state.step)
+        )
         save_checkpoint(
             directory,
             self.state,
             metadata={"kl_coef": float(kl_coef), "mean_kl": float(mean_kl)},
             async_save=self.config.train.async_checkpoint,
+            step=int(step),
         )
 
     def load(self, directory: str) -> None:
-        wait_for_checkpoints()  # join any in-flight async write first
         abstract = jax.tree_util.tree_map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             self.state,

@@ -1,18 +1,19 @@
 """Orbax checkpointing of train-state pytrees + host metadata.
 
 TPU-native replacement for ``accelerator.save_state/load_state``
-(`accelerate_base_model.py:144-146`, SURVEY §5.4): the whole train state
-(params, optimizer state, step) and the host-side loop metadata (KL
-coefficient, rollout KL) are saved as ONE composite Orbax checkpoint —
-sharded arrays are written/restored per-shard without host gathering, and
-the state+metadata pair commits atomically (no torn sidecar on a crash
-mid-write), mirroring what the reference's Ray `state.json`
-(`accelerate_base_model.py:232-240`) records.
+(`accelerate_base_model.py:144-146`, SURVEY §5.4). Checkpoints are managed
+by ``ocp.CheckpointManager``: each save lands in a step-numbered directory
+and the previous checkpoint is garbage-collected only *after* the new one
+commits — a crash mid-write (sync or async) always leaves the last good
+checkpoint restorable. State (sharded arrays, written/restored per-shard
+with no host gather) and host metadata (KL controller, the reference's Ray
+`state.json` analogue, `accelerate_base_model.py:232-240`) are one
+composite checkpoint, committed atomically.
 
 ``async_save=True`` returns once device arrays are snapshotted to host
-buffers; the filesystem write proceeds on Orbax's background thread
-(SURVEY §5.4 "Orbax async checkpointing"). :func:`wait_for_checkpoints`
-joins any in-flight write and surfaces background write errors.
+buffers; the write proceeds on Orbax's background thread (SURVEY §5.4
+"Orbax async checkpointing"). :func:`wait_for_checkpoints` joins in-flight
+writes and surfaces background write errors.
 """
 
 from __future__ import annotations
@@ -23,27 +24,23 @@ from typing import Any, Dict, Optional, Tuple
 
 import orbax.checkpoint as ocp
 
-# Long-lived async checkpointer: it owns a background thread pool and
-# (multi-host) a coordination barrier, so it must not be per-call.
-_async_ckptr: Optional[ocp.AsyncCheckpointer] = None
+# One manager per directory: managers own background threads, per-directory
+# step bookkeeping, and (multi-host) coordination state. Async is always
+# enabled at the manager level; a *sync* save simply joins the write before
+# returning — so a directory never has two managers with divergent GC state.
+_managers: Dict[str, ocp.CheckpointManager] = {}
 
 
-def _composite_handler():
-    return ocp.CompositeCheckpointHandler()
-
-
-def _get_async_ckptr() -> ocp.AsyncCheckpointer:
-    global _async_ckptr
-    if _async_ckptr is None:
-        _async_ckptr = ocp.AsyncCheckpointer(_composite_handler())
-    return _async_ckptr
-
-
-def _save_args(state: Any, metadata: Optional[Dict[str, Any]]):
-    return ocp.args.Composite(
-        state=ocp.args.StandardSave(state),
-        host_state=ocp.args.JsonSave(metadata or {}),
-    )
+def _manager(directory: str) -> ocp.CheckpointManager:
+    if directory not in _managers:
+        _managers[directory] = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=2,
+                enable_async_checkpointing=True,
+            ),
+        )
+    return _managers[directory]
 
 
 def save_checkpoint(
@@ -51,47 +48,76 @@ def save_checkpoint(
     state: Any,
     metadata: Optional[Dict[str, Any]] = None,
     async_save: bool = False,
+    step: Optional[int] = None,
 ) -> None:
-    """Save state + metadata as one atomically-committed checkpoint."""
+    """Save state + metadata as one atomically-committed checkpoint under
+    ``directory/<step>/``; the previous checkpoint survives until the new
+    one commits."""
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
-    path = os.path.join(directory, "state")
-    if async_save:
-        _get_async_ckptr().save(path, args=_save_args(state, metadata), force=True)
-    else:
-        with ocp.Checkpointer(_composite_handler()) as ckptr:
-            ckptr.save(path, args=_save_args(state, metadata), force=True)
+    mgr = _manager(directory)
+    if step is None:
+        step = (mgr.latest_step() or 0) + 1
+    args = ocp.args.Composite(
+        state=ocp.args.StandardSave(state),
+        host_state=ocp.args.JsonSave(metadata or {}),
+    )
+    try:
+        mgr.save(int(step), args=args, force=True)
+    except ocp.checkpoint_manager.StepAlreadyExistsError:
+        # same-step re-save (e.g. a fresh run writing into a directory a
+        # previous run used): replace that step's checkpoint
+        mgr.delete(int(step))
+        mgr.save(int(step), args=args, force=True)
+    if not async_save:
+        mgr.wait_until_finished()
 
 
 def wait_for_checkpoints() -> None:
-    """Block until any in-flight async checkpoint write has committed
+    """Block until in-flight async checkpoint writes have committed
     (re-raises background write errors)."""
-    if _async_ckptr is not None:
-        _async_ckptr.wait_until_finished()
+    for mgr in _managers.values():
+        mgr.wait_until_finished()
+
+
+def has_checkpoint(directory: str) -> bool:
+    """True when ``directory`` holds a restorable checkpoint (managed
+    step-numbered layout or the legacy ``state`` + sidecar layout)."""
+    directory = os.path.abspath(directory)
+    if os.path.isdir(os.path.join(directory, "state")):
+        return True  # legacy layout
+    if not os.path.isdir(directory):
+        return False
+    return any(name.isdigit() for name in os.listdir(directory))
 
 
 def load_checkpoint(
     directory: str, abstract_state: Any
 ) -> Tuple[Any, Dict[str, Any]]:
     """Restore into the shapes/shardings of ``abstract_state`` (obtain via
-    ``jax.eval_shape`` + shardings, or pass a live state of the right spec).
-    Reads both the composite layout and the legacy state-dir +
-    host_state.json sidecar layout."""
+    ``jax.eval_shape`` + shardings, or pass a live state of the right
+    spec). Reads the managed layout and the legacy state-dir + sidecar."""
     wait_for_checkpoints()
     directory = os.path.abspath(directory)
-    path = os.path.join(directory, "state")
-    legacy_json = os.path.join(directory, "host_state.json")
-    if os.path.exists(legacy_json):
+    legacy_state = os.path.join(directory, "state")
+    if os.path.isdir(legacy_state):
         with ocp.StandardCheckpointer() as ckptr:
-            state = ckptr.restore(path, abstract_state)
-        with open(legacy_json) as f:
-            return state, json.load(f)
-    with ocp.Checkpointer(_composite_handler()) as ckptr:
-        restored = ckptr.restore(
-            path,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract_state),
-                host_state=ocp.args.JsonRestore(),
-            ),
-        )
+            state = ckptr.restore(legacy_state, abstract_state)
+        metadata: Dict[str, Any] = {}
+        legacy_json = os.path.join(directory, "host_state.json")
+        if os.path.exists(legacy_json):
+            with open(legacy_json) as f:
+                metadata = json.load(f)
+        return state, metadata
+    mgr = _manager(directory)
+    step = mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint found under {directory}")
+    restored = mgr.restore(
+        step,
+        args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(abstract_state),
+            host_state=ocp.args.JsonRestore(),
+        ),
+    )
     return restored["state"], dict(restored["host_state"] or {})
