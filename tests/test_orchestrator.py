@@ -163,3 +163,17 @@ def test_collects_exactly_num_rollouts_in_chunks():
     trainer, _ = collect(config, [1.0] * 4, n=12, chunk=4)
     assert trainer.pushed == 12
     assert len(trainer.seen_scores) == 3
+
+
+def test_rollout_logging_dir_writes_jsonl(tmp_path):
+    import json
+
+    config = make_config("none")
+    config.train.rollout_logging_dir = str(tmp_path / "rollouts")
+    trainer, _ = collect(config, [1.5, 2.5], n=8, chunk=4)
+    files = sorted((tmp_path / "rollouts").glob("*.jsonl"))
+    assert files, "no rollout log written"
+    rows = [json.loads(l) for f in files for l in open(f)]
+    assert len(rows) == 8
+    assert {"query", "response", "score"} <= set(rows[0])
+    assert rows[0]["score"] == 1.5

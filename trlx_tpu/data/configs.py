@@ -115,6 +115,8 @@ class TrainConfig:
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
 
+    # when set, every collected rollout chunk is appended (one JSON line per
+    # sample: query/response text + raw score) to rollouts_<iter>.jsonl here
     rollout_logging_dir: Optional[str] = None
     # write a jax.profiler trace of the first ~10 optimizer steps here
     # (SURVEY §5.1: timing stats + optional jax.profiler integration)

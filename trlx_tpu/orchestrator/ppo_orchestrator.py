@@ -69,6 +69,28 @@ class PPOOrchestrator(Orchestrator):
             samples=samples, queries=queries, response_gt=response_gt
         )
 
+    def _log_rollouts(self, queries, texts, scores, iter_count: int) -> None:
+        """Append collected rollouts to ``train.rollout_logging_dir`` as
+        JSON lines (query/response/raw score), rank-0 only."""
+        directory = self.trainer.config.train.rollout_logging_dir
+        if not directory:
+            return
+        from trlx_tpu.parallel.distributed import is_main_process
+        from trlx_tpu.utils import safe_mkdir
+
+        if not is_main_process():
+            return
+        import json
+        import os
+
+        safe_mkdir(directory)
+        path = os.path.join(directory, f"rollouts_{iter_count}.jsonl")
+        with open(path, "a") as f:
+            for q, s, r in zip(queries, texts, scores):
+                f.write(json.dumps(
+                    {"query": q, "response": s, "score": float(r)}
+                ) + "\n")
+
     def _dispatch_chunk(self):
         """Enqueue one chunk's device work (sampler + frozen-ref forward)
         without waiting on it. Dispatch is async; the results are consumed
@@ -126,6 +148,7 @@ class PPOOrchestrator(Orchestrator):
             )
             score_time += t.tick() / 1000.0
             all_scores.append(scores.copy())
+            self._log_rollouts(queries, texts, scores, iter_count)
 
             # reward scaling + clip (`ppo_orchestrator.py:96-112`). The
             # reference seeds ref stats from the first rollout batch when

@@ -62,11 +62,16 @@ def save_checkpoint(
         state=ocp.args.StandardSave(state),
         host_state=ocp.args.JsonSave(metadata or {}),
     )
+    # A fresh run reusing a directory from a longer previous run: steps
+    # beyond the one being written belong to the stale timeline — drop them,
+    # or retention GC would keep them and delete this run's checkpoint,
+    # and resume would restore the old run's state via latest_step().
+    for stale in [s for s in mgr.all_steps() if s > int(step)]:
+        mgr.delete(stale)
     try:
         mgr.save(int(step), args=args, force=True)
     except ocp.checkpoint_manager.StepAlreadyExistsError:
-        # same-step re-save (e.g. a fresh run writing into a directory a
-        # previous run used): replace that step's checkpoint
+        # same-step re-save: replace that step's checkpoint
         mgr.delete(int(step))
         mgr.save(int(step), args=args, force=True)
     if not async_save:
@@ -99,8 +104,12 @@ def load_checkpoint(
     spec). Reads the managed layout and the legacy state-dir + sidecar."""
     wait_for_checkpoints()
     directory = os.path.abspath(directory)
+    mgr = _manager(directory)
+    step = mgr.latest_step()
     legacy_state = os.path.join(directory, "state")
-    if os.path.isdir(legacy_state):
+    if step is None and os.path.isdir(legacy_state):
+        # legacy layout only — once managed steps exist they are newer
+        # (an upgraded run keeps saving next to the old 'state' dir)
         with ocp.StandardCheckpointer() as ckptr:
             state = ckptr.restore(legacy_state, abstract_state)
         metadata: Dict[str, Any] = {}
@@ -109,8 +118,6 @@ def load_checkpoint(
             with open(legacy_json) as f:
                 metadata = json.load(f)
         return state, metadata
-    mgr = _manager(directory)
-    step = mgr.latest_step()
     if step is None:
         raise FileNotFoundError(f"no checkpoint found under {directory}")
     restored = mgr.restore(
