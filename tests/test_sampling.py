@@ -322,3 +322,41 @@ def test_max_length_caps_total_length_per_sequence(tiny_policy):
     lens = np.asarray(out.response_mask).sum(axis=1)
     assert lens[0] <= 2, lens  # 6 + 2 = 8
     assert lens[1] <= 6, lens  # budget-limited (2 + 6 = 8)
+
+
+def test_filter_logits_top_p_nucleus():
+    """top-p keeps the smallest prefix of tokens (by prob) whose cumulative
+    mass reaches p, always >= 1 token (HF TopPLogitsWarper semantics)."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.sampling import GenerationConfig, filter_logits
+
+    # probs ~ [0.6439, 0.2369, 0.0871, 0.0321] for logits [3,2,1,0]
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+    out = np.asarray(
+        filter_logits(logits, GenerationConfig(top_p=0.7, top_k=0))
+    )[0]
+    # 0.6439 < 0.7 -> token0 kept; adding token1 exceeds -> token1 kept
+    # (cum - probs < p rule keeps the boundary token), rest masked
+    assert np.isfinite(out[0]) and np.isfinite(out[1])
+    assert np.isneginf(out[2]) and np.isneginf(out[3])
+
+    # p smaller than the top prob still keeps >= 1 token
+    out = np.asarray(
+        filter_logits(logits, GenerationConfig(top_p=0.1, top_k=0))
+    )[0]
+    assert np.isfinite(out[0]) and np.isneginf(out[1:]).all()
+
+
+def test_filter_logits_temperature_and_top_k():
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.sampling import GenerationConfig, filter_logits
+
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0]])
+    out = np.asarray(
+        filter_logits(logits, GenerationConfig(temperature=2.0, top_k=0))
+    )[0]
+    np.testing.assert_allclose(out, [2.0, 1.5, 1.0, 0.5])
+    out = np.asarray(filter_logits(logits, GenerationConfig(top_k=2)))[0]
+    assert np.isfinite(out[:2]).all() and (out[2:] < -1e8).all()

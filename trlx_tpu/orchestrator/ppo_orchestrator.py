@@ -19,6 +19,8 @@ in the rollout loop"):
 
 from __future__ import annotations
 
+import json
+import os
 from typing import Callable
 
 import numpy as np
@@ -27,7 +29,8 @@ from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
 from trlx_tpu.data.ppo_types import PPORolloutBatch
 from trlx_tpu.ops.ppo_math import PPOConfig
 from trlx_tpu.parallel.collectives import RunningMoments
-from trlx_tpu.utils import Clock, infinite_loader
+from trlx_tpu.parallel.distributed import is_main_process
+from trlx_tpu.utils import Clock, infinite_loader, safe_mkdir
 
 
 @register_orchestrator
@@ -73,16 +76,8 @@ class PPOOrchestrator(Orchestrator):
         """Append collected rollouts to ``train.rollout_logging_dir`` as
         JSON lines (query/response/raw score), rank-0 only."""
         directory = self.trainer.config.train.rollout_logging_dir
-        if not directory:
+        if not directory or not is_main_process():
             return
-        from trlx_tpu.parallel.distributed import is_main_process
-        from trlx_tpu.utils import safe_mkdir
-
-        if not is_main_process():
-            return
-        import json
-        import os
-
         safe_mkdir(directory)
         path = os.path.join(directory, f"rollouts_{iter_count}.jsonl")
         with open(path, "a") as f:

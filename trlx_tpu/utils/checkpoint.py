@@ -63,17 +63,23 @@ def save_checkpoint(
         host_state=ocp.args.JsonSave(metadata or {}),
     )
     # A fresh run reusing a directory from a longer previous run: steps
-    # beyond the one being written belong to the stale timeline — drop them,
-    # or retention GC would keep them and delete this run's checkpoint,
-    # and resume would restore the old run's state via latest_step().
-    for stale in [s for s in mgr.all_steps() if s > int(step)]:
-        mgr.delete(stale)
+    # beyond the one being written belong to the stale timeline and must go
+    # (retention GC keeps latest-by-step and would otherwise delete this
+    # run's checkpoint; resume would restore the old run via latest_step()).
+    # Keep the newest stale step until the new save commits so a crash in
+    # between never leaves the directory with zero restorable checkpoints.
+    stale = sorted(s for s in mgr.all_steps() if s > int(step))
+    for s in stale[:-1]:
+        mgr.delete(s)
     try:
         mgr.save(int(step), args=args, force=True)
     except ocp.checkpoint_manager.StepAlreadyExistsError:
         # same-step re-save: replace that step's checkpoint
         mgr.delete(int(step))
         mgr.save(int(step), args=args, force=True)
+    if stale:
+        mgr.wait_until_finished()  # new step committed -> stale can go
+        mgr.delete(stale[-1])
     if not async_save:
         mgr.wait_until_finished()
 
