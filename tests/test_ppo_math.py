@@ -168,3 +168,48 @@ def test_topk_mask():
     out = np.asarray(topk_mask(xs, 2))
     assert out[0, 1] == 5.0 and out[0, 2] == 3.0
     assert np.isinf(out[0, 0]) and np.isinf(out[0, 3])
+
+
+def test_entropy_bonus_in_loss():
+    """ent_coef subtracts mean masked entropy from the loss; ent_coef=0 is
+    the exact reference loss (entropy stat zero, no term)."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.ppo_math import ppo_loss
+
+    B, R = 2, 3
+    rng = np.random.default_rng(0)
+    args = dict(
+        logprobs=jnp.asarray(rng.normal(size=(B, R)), jnp.float32),
+        values=jnp.asarray(rng.normal(size=(B, R)), jnp.float32),
+        old_logprobs=jnp.asarray(rng.normal(size=(B, R)), jnp.float32),
+        old_values=jnp.asarray(rng.normal(size=(B, R)), jnp.float32),
+        advantages=jnp.asarray(rng.normal(size=(B, R)), jnp.float32),
+        returns=jnp.asarray(rng.normal(size=(B, R)), jnp.float32),
+        mask=jnp.asarray([[1, 1, 0], [1, 0, 0]], jnp.int32),
+        cliprange=0.2, cliprange_value=0.2, vf_coef=1.0,
+    )
+    entropy = jnp.asarray([[2.0, 4.0, 99.0], [6.0, 99.0, 99.0]], jnp.float32)
+    base, base_stats = ppo_loss(**args)
+    with_ent, stats = ppo_loss(**args, ent_coef=0.5, entropy=entropy)
+    mean_h = (2.0 + 4.0 + 6.0) / 3  # masked mean
+    np.testing.assert_allclose(float(stats["losses/entropy"]), mean_h, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(with_ent), float(base) - 0.5 * mean_h, rtol=1e-6
+    )
+    assert float(base_stats["losses/entropy"]) == 0.0
+
+
+def test_policy_entropy_matches_scipy():
+    import jax.numpy as jnp
+
+    from trlx_tpu.trainer.ppo_trainer import _policy_entropy
+
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(2, 3, 8)).astype(np.float32)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    expected = -(p * np.log(p)).sum(-1)
+    np.testing.assert_allclose(
+        np.asarray(_policy_entropy(jnp.asarray(logits))), expected, rtol=1e-5
+    )

@@ -59,6 +59,8 @@ class PPOConfig(MethodConfig):
     cliprange: float = 0.2
     cliprange_value: float = 0.2
     vf_coef: float = 1.0
+    # entropy-bonus weight (beyond parity; 0 = exact reference loss)
+    ent_coef: float = 0.0
     scale_reward: Optional[str] = None
     ref_mean: Optional[float] = None
     ref_std: Optional[float] = None
@@ -120,11 +122,18 @@ def ppo_loss(
     cliprange: float,
     cliprange_value: float,
     vf_coef: float,
+    ent_coef: float = 0.0,
+    entropy: Optional[jax.Array] = None,  # [B, R] per-position policy entropy
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Clipped-surrogate PPO loss (reference `ppo_models.py:141-199`).
 
     Returns (scalar loss, stats dict). All means are masked over real
     response tokens; under a sharded batch the means are global (GSPMD).
+
+    ``ent_coef``/``entropy`` add an optional entropy bonus (beyond parity —
+    the reference has none): ``loss -= ent_coef * mean(entropy)``. Sparse
+    terminal-reward tasks (randomwalks) can collapse into low-entropy local
+    optima without it.
     """
     mask = mask.astype(values.dtype)
     n = jnp.maximum(jnp.sum(mask), 1.0)
@@ -148,11 +157,16 @@ def ppo_loss(
     pg_clipfrac = jnp.sum((pg_loss2 > pg_loss1) * mask) / n
 
     loss = pg_loss + vf_coef * vf_loss
+    mean_entropy = jnp.zeros(())
+    if ent_coef and entropy is not None:
+        mean_entropy = jnp.sum(entropy * mask) / n
+        loss = loss - ent_coef * mean_entropy
 
     stats = {
         "losses/total_loss": loss,
         "losses/policy_loss": pg_loss,
         "losses/value_loss": vf_loss,
+        "losses/entropy": mean_entropy,
         "policy/approx_kl": approx_kl,
         "policy/clipfrac": pg_clipfrac,
         "values/clipfrac": vf_clipfrac,

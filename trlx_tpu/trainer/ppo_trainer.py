@@ -103,6 +103,13 @@ def get_gpt2_arch(config: TRLConfig):
     return arch, params
 
 
+def _policy_entropy(logits: jax.Array) -> jax.Array:
+    """Per-position policy entropy H = logsumexp(l) - sum softmax(l) * l."""
+    l = logits.astype(jnp.float32)
+    p = jax.nn.softmax(l, axis=-1)
+    return jax.scipy.special.logsumexp(l, axis=-1) - jnp.sum(p * l, axis=-1)
+
+
 @register_trainer
 class PPOTrainer(BaseRLTrainer):
     # param-tree key holding the (KL-reference) backbone
@@ -258,11 +265,13 @@ class PPOTrainer(BaseRLTrainer):
         )
 
     def _forward_logprobs_values(self, params, mb: PPORolloutBatch):
-        """Policy forward -> (logprobs, values) over response positions.
+        """Policy forward -> (logprobs, values, entropy?) over response
+        positions.
 
         Causal LM: forward [query; response]; hidden states are sliced to
         positions Q-1..Q+R-2 (the states that *predict* each response token)
-        *before* the LM/value heads run (``response_forward``)."""
+        *before* the LM/value heads run (``response_forward``). Per-position
+        entropy is computed only when the entropy bonus is on."""
         Q = self.query_length
         full_ids = jnp.concatenate([mb.query_tokens, mb.response_tokens], axis=1)
         full_mask = jnp.concatenate([mb.query_mask, mb.response_mask], axis=1)
@@ -271,7 +280,10 @@ class PPOTrainer(BaseRLTrainer):
             method=self.model.response_forward,
         )
         logprobs = logprobs_from_logits(logits, mb.response_tokens)
-        return logprobs, values.astype(jnp.float32)
+        entropy = (
+            _policy_entropy(logits) if self.config.method.ent_coef else None
+        )
+        return logprobs, values.astype(jnp.float32), entropy
 
     def _supports_hydra(self) -> bool:
         return True
@@ -365,7 +377,9 @@ class PPOTrainer(BaseRLTrainer):
 
         def train_step(state: TrainState, mb: PPORolloutBatch):
             def loss_fn(params):
-                logprobs, values = self._forward_logprobs_values(params, mb)
+                logprobs, values, entropy = self._forward_logprobs_values(
+                    params, mb
+                )
                 advantages, returns = get_advantages_and_returns(
                     mb.values, mb.rewards, mb.response_mask, method.gamma, method.lam
                 )
@@ -380,6 +394,8 @@ class PPOTrainer(BaseRLTrainer):
                     method.cliprange,
                     method.cliprange_value,
                     method.vf_coef,
+                    ent_coef=method.ent_coef,
+                    entropy=entropy,
                 )
 
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(

@@ -32,7 +32,7 @@ from trlx_tpu.models.t5 import (
 from trlx_tpu.ops.sampling import make_seq2seq_sampler
 from trlx_tpu.parallel import logprobs_from_logits
 from trlx_tpu.trainer import register_trainer
-from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer, _policy_entropy
 
 
 def get_t5_arch(config: TRLConfig):
@@ -123,7 +123,12 @@ class Seq2SeqPPOTrainer(PPOTrainer):
             decoder_attention_mask=dec_mask,
         )
         logprobs = logprobs_from_logits(out["logits"], mb.response_tokens)
-        return logprobs, out["values"].astype(jnp.float32)
+        entropy = (
+            _policy_entropy(out["logits"])
+            if self.config.method.ent_coef
+            else None
+        )
+        return logprobs, out["values"].astype(jnp.float32), entropy
 
     def _supports_hydra(self) -> bool:
         # the fork disables the hydra branch for T5 and uses a full frozen
