@@ -268,3 +268,44 @@ def seq2seq_learned():
 
 def test_seq2seq_reward_improves(seq2seq_learned):
     assert_reward_improved(seq2seq_learned)
+
+
+def test_detect_anomalies_aborts_on_nan_reward():
+    """A reward fn returning NaN must abort with a clear divergence error
+    instead of silently training on NaNs (train.detect_anomalies)."""
+    os.environ["WANDB_DISABLED"] = "1"
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "model_arch": {
+                    "vocab_size": 16, "n_positions": 16, "n_embd": 32,
+                    "n_layer": 1, "n_head": 2,
+                },
+            },
+            "train": {
+                "seq_length": 4, "batch_size": 16, "epochs": 2,
+                "total_steps": 8, "eval_interval": 10000,
+                "checkpoint_interval": 100000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1}, "dtype": "float32",
+            },
+            "method": {
+                "name": "PPOConfig", "num_rollouts": 16, "chunk_size": 16,
+                "ppo_epochs": 1, "scale_reward": None,
+                "gen_kwargs": {"max_new_tokens": 4, "do_sample": True,
+                               "eos_token_id": 14, "pad_token_id": 15},
+            },
+        }
+    )
+    prompts = [[i % 12 + 1] for i in range(16)]
+    with pytest.raises(RuntimeError, match="non-finite"):
+        trlx_tpu.train(
+            reward_fn=lambda samples, queries, response_gt=None: [
+                float("nan")
+            ] * len(samples),
+            prompts=prompts,
+            config=config,
+        )

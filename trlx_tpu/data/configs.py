@@ -107,6 +107,11 @@ class TrainConfig:
     # write checkpoints on Orbax's background thread: the train loop resumes
     # as soon as device arrays are snapshotted to host buffers
     async_checkpoint: bool = False
+    # failure detection (beyond the reference, SURVEY §5.3 "none"): abort
+    # with a clear error when the fetched loss stats go non-finite, instead
+    # of silently training on NaNs. Checked wherever stats already cross to
+    # host (every fused pass / ILQL chunk; log steps on the stepwise path).
+    detect_anomalies: bool = True
     project_name: str = "trlx_tpu"
     run_name: str = ""
     seed: int = 1000

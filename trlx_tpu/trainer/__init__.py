@@ -81,6 +81,35 @@ class BaseRLTrainer(ABC):
             "do_save": step > 0 and step % t.checkpoint_interval == 0,
         }
 
+    def check_anomalies(self, stats: Dict[str, Any], step: int) -> None:
+        """Abort with a clear error when fetched loss stats go non-finite
+        (``train.detect_anomalies``; beyond the reference — SURVEY §5.3
+        records no failure detection). ``stats`` values may be scalars or
+        stacked per-update rows; only host-side (already-fetched) values are
+        examined, so the check costs no device round-trip."""
+        if not self.config.train.detect_anomalies:
+            return
+        for key, v in stats.items():
+            if not key.startswith("losses/"):
+                continue
+            arr = np.asarray(v, dtype=np.float64)
+            finite = np.isfinite(arr)
+            if not finite.all():
+                if arr.ndim == 0:
+                    at, value = step, float(arr)
+                else:
+                    # stacked per-update rows: `step` is the count *before*
+                    # the fused pass, row r is update step + r + 1
+                    first_bad = int(np.argmin(finite.ravel()))
+                    at = step + first_bad + 1
+                    value = float(arr.ravel()[first_bad])
+                raise RuntimeError(
+                    f"non-finite {key} ({value}) detected at step {at} — "
+                    "training diverged. Inspect the learning rate / reward "
+                    "scale, or resume from the last checkpoint in "
+                    f"{self.config.train.checkpoint_dir!r}."
+                )
+
     @abstractmethod
     def learn(self) -> None: ...
 

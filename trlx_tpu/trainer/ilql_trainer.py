@@ -369,6 +369,7 @@ class ILQLTrainer(BaseRLTrainer):
                 chunk_time = clock.tick(train.batch_size) / 1000.0
                 # one transfer event for the whole stacked stats tree
                 rows = jax.device_get(stacked)
+                self.check_anomalies(rows, iter_count)
                 for j in range(k):
                     iter_count += 1
                     step_stats = {key: float(v[j]) for key, v in rows.items()}

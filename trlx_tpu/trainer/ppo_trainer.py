@@ -595,6 +595,7 @@ class PPOTrainer(BaseRLTrainer):
                 rows, kl_seq, mean_kl = jax.device_get(
                     (stacked, kl_seq, self.mean_kl)
                 )
+                self.check_anomalies(rows, iter_count)
                 step_stats = {}
                 for k in range(n_minibatches):
                     iter_count += method.ppo_epochs
@@ -653,6 +654,7 @@ class PPOTrainer(BaseRLTrainer):
                 iv = self.intervals(iter_count)
                 if iv["do_log"]:
                     step_stats = jax.device_get(step_stats)
+                    self.check_anomalies(step_stats, iter_count)
                     logger.log(step_stats, step=iter_count)
                     final_stats = {k: float(v) for k, v in step_stats.items()}
                 if iv["do_eval"]:
@@ -660,8 +662,11 @@ class PPOTrainer(BaseRLTrainer):
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
                 if iv["do_save"]:
+                    # never persist a NaN state between log points
+                    self.check_anomalies(jax.device_get(step_stats), iter_count)
                     self.save()
                 if iter_count >= total_steps:
+                    self.check_anomalies(jax.device_get(step_stats), iter_count)
                     self.save()
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)
