@@ -309,3 +309,39 @@ def test_detect_anomalies_aborts_on_nan_reward():
             prompts=prompts,
             config=config,
         )
+
+
+def test_ilql_detect_anomalies_aborts_on_nan_reward():
+    """The ILQL chunked loop checks fetched loss stats too."""
+    os.environ["WANDB_DISABLED"] = "1"
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "model_arch": {
+                    "vocab_size": 16, "n_positions": 16, "n_embd": 32,
+                    "n_layer": 1, "n_head": 2,
+                },
+            },
+            "train": {
+                "seq_length": 8, "batch_size": 16, "epochs": 1,
+                "total_steps": 8, "eval_interval": 10000,
+                "checkpoint_interval": 100000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1}, "dtype": "float32",
+            },
+            "method": {
+                "name": "ILQLConfig", "two_qs": True,
+                "gen_kwargs": {"max_new_tokens": 4, "do_sample": True,
+                               "eos_token_id": 14, "pad_token_id": 15},
+            },
+        }
+    )
+    rng = np.random.default_rng(0)
+    samples = [(list(rng.integers(1, 13, size=6)), 1) for _ in range(64)]
+    rewards = [float("nan")] * 64
+    with pytest.raises(RuntimeError, match="non-finite"):
+        trlx_tpu.train(dataset=(samples, rewards), config=config,
+                       eval_prompts=[[1]] * 16)
