@@ -147,3 +147,33 @@ def test_async_checkpoint_roundtrip(tmp_path):
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert "kl_coef" in meta
+
+
+def test_legacy_checkpoint_layout_still_restores(tmp_path):
+    """Pre-CheckpointManager checkpoints ('state' dir + host_state.json
+    sidecar) must keep restoring through load_checkpoint."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from trlx_tpu.utils.checkpoint import has_checkpoint, load_checkpoint
+
+    state = {"w": jnp.arange(8, dtype=jnp.float32), "step": jnp.asarray(7)}
+    directory = tmp_path / "legacy"
+    directory.mkdir()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(str(directory / "state"), state)
+    with open(directory / "host_state.json", "w") as f:
+        json.dump({"kl_coef": 0.125}, f)
+
+    assert has_checkpoint(str(directory))
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )
+    restored, meta = load_checkpoint(str(directory), abstract)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8))
+    assert int(restored["step"]) == 7
+    assert meta == {"kl_coef": 0.125}
