@@ -6,6 +6,13 @@ against a user reward function, offline ILQL on reward-labeled datasets, for
 causal LMs (GPT-2 family) and T5/UL2 seq2seq models, sharded over a TPU mesh.
 """
 
-__version__ = "0.1.0"
+# single source of truth is pyproject.toml; fall back when not installed
+try:
+    from importlib.metadata import version as _pkg_version
+
+    __version__ = _pkg_version("trlx_tpu")
+except Exception:
+    __version__ = "0.3.0"  # tracks the reference's trlX version (setup.cfg:1-8)
 
 from trlx_tpu.api import train  # noqa: E402,F401
+from trlx_tpu.data.configs import TRLConfig  # noqa: E402,F401
