@@ -149,6 +149,10 @@ def test_async_checkpoint_roundtrip(tmp_path):
     assert "kl_coef" in meta
 
 
+@pytest.mark.filterwarnings(
+    # restoring without explicit shardings is the point of this test
+    "ignore:Sharding info not provided when restoring"
+)
 def test_legacy_checkpoint_layout_still_restores(tmp_path):
     """Pre-CheckpointManager checkpoints ('state' dir + host_state.json
     sidecar) must keep restoring through load_checkpoint."""
