@@ -103,6 +103,15 @@ class PPOOrchestrator(Orchestrator):
             sample_out.tokens,
             sample_out.response_mask,
         )
+        # Start the device->host copy of what decode_responses will need as
+        # soon as the sampler finishes (the copy is scheduled behind the
+        # computation): by the time the host fetches, the ~100ms transfer
+        # has already overlapped the previous chunk's scoring.
+        for arr in (sample_out.tokens, sample_out.response_mask):
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                break  # backend without async copies: plain fetch later
         return batch, meta, sample_out, ref_logprobs, dispatch_ms
 
     def make_experience(self, num_rollouts: int = 128, iter_count: int = 0):
