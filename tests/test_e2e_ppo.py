@@ -160,3 +160,44 @@ def test_e2e_ppo_mixed_mesh_fsdp_tp():
         jax.tree_util.tree_map(lambda x: x.sharding.spec, trainer.state.params)
     )
     assert any(s is not None for spec in shardings for s in spec), shardings[:5]
+
+
+def test_max_length_at_seq_length_rejected():
+    """Regression (round-1 review): a prompt filling the whole seq_length
+    budget emits a zero-length response; its terminal score lands on a
+    masked slot and GAE silently zeroes it. The trainer must refuse such
+    configs up front."""
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = _tiny_config()
+    config.method.gen_kwargs = dict(
+        config.method.gen_kwargs, max_length=config.train.seq_length
+    )
+    with pytest.raises(ValueError, match="max_length"):
+        get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+
+
+def test_capped_prompts_keep_terminal_reward():
+    """With max_length > seq_length, prompts at the sequence budget still
+    emit >= 1 response token, so the terminal score always lands on a valid
+    slot (sum of shaped rewards == score when policy == ref)."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = _tiny_config()
+    config.method.gen_kwargs = dict(
+        config.method.gen_kwargs, max_length=config.train.seq_length + 1
+    )
+    trainer = get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+    B, Q = 8, config.train.seq_length
+    prompt_ids = jnp.ones((B, Q), jnp.int32)
+    prompt_mask = jnp.ones((B, Q), jnp.int32)  # every prompt at the cap
+    out = trainer.sample(prompt_ids, prompt_mask)
+    assert int(out.response_mask.sum(axis=1).min()) >= 1
+    scores = np.full((B,), 2.5, np.float32)
+    rewards = trainer.compute_rewards(
+        out.logprobs, out.logprobs, out.response_mask, scores
+    )
+    per_row = np.asarray(rewards * out.response_mask).sum(axis=1)
+    np.testing.assert_allclose(per_row, scores, rtol=1e-6)

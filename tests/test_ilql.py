@@ -266,3 +266,17 @@ def test_ilql_mixed_mesh_fsdp_tp():
     assert int(trainer.state.step) == 4  # 64/16 minibatches x 1 epoch
     leaves = jax.device_get(jax.tree_util.tree_leaves(trainer.state.params))
     assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
+
+
+def test_ilql_gen_defaults_are_config_visible():
+    """Sampling fallbacks live in ILQLConfig.gen_kwargs (not hardcoded in
+    the trainer); user keys override individually (reference builds these
+    in `accelerate_ilql_model.py:87-93`)."""
+    from trlx_tpu.ops.ilql_math import DEFAULT_ILQL_GEN_KWARGS, ILQLConfig
+
+    cfg = ILQLConfig.from_dict({"name": "ILQLConfig"})
+    assert cfg.gen_kwargs == DEFAULT_ILQL_GEN_KWARGS
+    cfg2 = ILQLConfig.from_dict({"name": "ILQLConfig", "gen_kwargs": {"top_k": 5}})
+    assert cfg2.gen_kwargs["top_k"] == 5
+    assert cfg2.gen_kwargs["max_new_tokens"] == 48
+    assert cfg2.gen_kwargs["do_sample"] is True

@@ -171,7 +171,9 @@ def test_rollout_logging_dir_writes_jsonl(tmp_path):
     config = make_config("none")
     config.train.rollout_logging_dir = str(tmp_path / "rollouts")
     trainer, _ = collect(config, [1.5, 2.5], n=8, chunk=4)
-    files = sorted((tmp_path / "rollouts").glob("*.jsonl"))
+    # each run logs under its own run_<timestamp> subdirectory so re-runs
+    # reusing the directory never append to an earlier run's files
+    files = sorted((tmp_path / "rollouts").glob("run_*/*.jsonl"))
     assert files, "no rollout log written"
     rows = [json.loads(l) for f in files for l in open(f)]
     assert len(rows) == 8

@@ -26,6 +26,14 @@ import jax.numpy as jnp
 from trlx_tpu.data.ilql_types import ILQLBatch
 from trlx_tpu.data.method_configs import MethodConfig, register_method
 
+# Evaluation-decode defaults when a config omits gen_kwargs (reference
+# hardcodes these in `accelerate_ilql_model.py:87-93`).
+DEFAULT_ILQL_GEN_KWARGS: Dict[str, Any] = {
+    "max_new_tokens": 48,
+    "do_sample": True,
+    "top_k": 20,
+}
+
 
 @register_method
 @dataclass
@@ -42,13 +50,26 @@ class ILQLConfig(MethodConfig):
     betas: Tuple[float, ...] = (4.0,)
     two_qs: bool = True
     # generation params for evaluation decode (reference builds these in
-    # `accelerate_ilql_model.py:87-93`)
-    gen_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # `accelerate_ilql_model.py:87-93`). Defaults are declared here — not
+    # hardcoded in the trainer — so a config diff shows the effective
+    # sampling behavior; user-provided keys override individually.
+    gen_kwargs: Dict[str, Any] = field(
+        default_factory=lambda: dict(DEFAULT_ILQL_GEN_KWARGS)
+    )
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
         if "betas" in config:
             config = dict(config, betas=tuple(config["betas"]))
+        if "gen_kwargs" in config:
+            # a bare `gen_kwargs:` YAML line parses as None
+            config = dict(
+                config,
+                gen_kwargs={
+                    **DEFAULT_ILQL_GEN_KWARGS,
+                    **(config["gen_kwargs"] or {}),
+                },
+            )
         return super().from_dict(config)
 
 

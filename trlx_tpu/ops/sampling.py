@@ -41,9 +41,12 @@ class GenerationConfig:
     # policy can collapse into emitting eos immediately — a degenerate local
     # optimum the reference randomwalks config guards with `min_length: 2`):
     # - ``min_new_tokens``: suppress eos for the first k decode steps;
-    # - ``min_length``: minimum *total* length — real prompt tokens +
-    #   generated for causal LMs, decoder tokens incl. the start token for
-    #   seq2seq — matching what HF counts for each architecture.
+    # - ``min_length``: minimum *total* length. For causal LMs we count
+    #   *real* (non-pad) prompt tokens per row — a deliberate divergence
+    #   from HF's MinLengthLogitsProcessor, which counts the padded row
+    #   width (input_ids.shape[-1]) and so under-suppresses short prompts
+    #   in left-padded mixed-length batches. For seq2seq: decoder tokens
+    #   incl. the start token, as HF counts.
     min_new_tokens: int = 0
     min_length: int = 0
     # HF-style total-length cap (prompt + generated for causal; decoder
@@ -67,7 +70,11 @@ class GenerationConfig:
         d = dict(d)
         # reference configs write HF's ``max_length`` (their gen budget;
         # `configs/ppo_config.yml` "LM max sample gen length") — map it to
-        # the decode budget rather than silently dropping it
+        # the decode budget rather than silently dropping it. Note this
+        # over-allocates: the compiled decode scans max_length steps (and
+        # sizes the KV cache for them) even when long prompts eat most of
+        # the total budget; the cap masks the surplus steps as pad. Set
+        # max_new_tokens explicitly to bound decode work for long prompts.
         if "max_length" in d and "max_new_tokens" not in d:
             d["max_new_tokens"] = d["max_length"]
         known = {f.name for f in dataclasses.fields(cls)}

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Callable
 
 import numpy as np
@@ -65,6 +66,9 @@ class PPOOrchestrator(Orchestrator):
         self.ref_std = trainer.config.method.ref_std
         # back-reference, as the reference installs (`ppo_orchestrator.py:45`)
         trainer.orch = self
+        # pid suffix: two jobs sharing a rollout_logging_dir that start in
+        # the same second must still get distinct run directories
+        self._run_id = f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
 
     def score(self, samples, queries, response_gt):
         """User reward fn call (host Python; `ppo_orchestrator.py:53-57`)."""
@@ -74,10 +78,14 @@ class PPOOrchestrator(Orchestrator):
 
     def _log_rollouts(self, queries, texts, scores, iter_count: int) -> None:
         """Append collected rollouts to ``train.rollout_logging_dir`` as
-        JSON lines (query/response/raw score), rank-0 only."""
+        JSON lines (query/response/raw score), rank-0 only. Each run writes
+        under its own ``run_<timestamp>`` subdirectory so a resumed/re-run
+        job reusing the directory never appends rows indistinguishable from
+        an earlier run's."""
         directory = self.trainer.config.train.rollout_logging_dir
         if not directory or not is_main_process():
             return
+        directory = os.path.join(directory, f"run_{self._run_id}")
         safe_mkdir(directory)
         path = os.path.join(directory, f"rollouts_{iter_count}.jsonl")
         with open(path, "a") as f:
@@ -120,6 +128,7 @@ class PPOOrchestrator(Orchestrator):
         stats = {}
         collected = 0
         generate_time = 0.0
+        dispatch_time = 0.0
         score_time = 0.0
         all_scores = []
 
@@ -132,13 +141,20 @@ class PPOOrchestrator(Orchestrator):
         pending = self._dispatch_chunk()
         while collected < num_rollouts:
             batch, meta, sample_out, ref_logprobs, dispatch_ms = pending
-            generate_time += dispatch_ms / 1000.0
+            dispatch_time += dispatch_ms / 1000.0
             if collected + len(batch.input_ids) < num_rollouts:
                 pending = self._dispatch_chunk()
 
+            # time-to-tokens-available: decode_responses blocks on the
+            # device->host copy of the sampler's output, so this is where
+            # generation cost actually lands (the reference's
+            # exp_generate_time meaning); dispatch_time alone reads ~0
+            # because the sampler call above only enqueues work.
+            t = Clock()
             texts = self.trainer.decode_responses(
                 sample_out.tokens, sample_out.response_mask
             )
+            generate_time += t.tick() / 1000.0
             if meta["prompts_text"][0] is not None:
                 queries = meta["prompts_text"]
             else:
@@ -195,6 +211,7 @@ class PPOOrchestrator(Orchestrator):
         stats.update(
             {
                 "exp/generate_time": generate_time,
+                "exp/dispatch_time": dispatch_time,
                 "exp/score_time": score_time,
                 "exp/experience_time": exp_time,
                 "exp/score_mean": float(scores_cat.mean()),

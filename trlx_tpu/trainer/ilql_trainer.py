@@ -95,9 +95,10 @@ class ILQLTrainer(BaseRLTrainer):
             backbone_cls=self.family.backbone_cls,
         )
 
-        gen_kwargs = {"max_new_tokens": 48, "do_sample": True, "top_k": 20}
+        # sampling defaults live in ILQLConfig.gen_kwargs (config-visible);
+        # the tokenizer only fills missing eos/pad ids
+        gen_kwargs = dict(method.gen_kwargs or {})
         self.apply_tokenizer_gen_defaults(gen_kwargs)
-        gen_kwargs.update(getattr(method, "gen_kwargs", {}) or {})
         self.gen_config = GenerationConfig.from_dict(gen_kwargs)
         validate_gen_config(
             self.gen_config,

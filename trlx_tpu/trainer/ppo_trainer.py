@@ -146,6 +146,7 @@ class PPOTrainer(BaseRLTrainer):
         self._amend_gen_kwargs(gen_kwargs)
         self.gen_config = GenerationConfig.from_dict(gen_kwargs)
         self.query_length = train.seq_length
+        self._check_response_budget(train)
         validate_gen_config(
             self.gen_config,
             getattr(self.model_config, "vocab_size", None),
@@ -231,6 +232,21 @@ class PPOTrainer(BaseRLTrainer):
 
     def _amend_gen_kwargs(self, gen_kwargs: Dict) -> None:
         pass
+
+    def _check_response_budget(self, train) -> None:
+        """Every rollout must have >= 1 response token by construction: a
+        zero-length response's terminal score lands on a masked slot and
+        GAE (`ops/ppo_math.py` rewards*mask) silently zeroes it. For causal
+        LMs, gen max_length caps prompt + generated, so a prompt filling
+        the whole seq_length budget would emit an empty response."""
+        if 0 < self.gen_config.max_length <= train.seq_length:
+            raise ValueError(
+                f"gen_kwargs max_length={self.gen_config.max_length} must "
+                f"exceed train.seq_length={train.seq_length}: prompts at the "
+                "sequence budget would emit zero-length responses whose "
+                "terminal rewards PPO silently drops; raise max_length or "
+                "use max_new_tokens"
+            )
 
     def _n_layers(self) -> int:
         from trlx_tpu.models.registry import num_layers_of

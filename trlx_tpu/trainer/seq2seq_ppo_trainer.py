@@ -62,6 +62,18 @@ def get_t5_arch(config: TRLConfig):
 class Seq2SeqPPOTrainer(PPOTrainer):
     backbone_key = "t5"
 
+    def _check_response_budget(self, train) -> None:
+        # For seq2seq, gen max_length caps *decoder* tokens (incl. the
+        # start token), independent of the encoder budget train.seq_length;
+        # >= 2 guarantees at least one real response token per rollout.
+        if 0 < self.gen_config.max_length < 2:
+            raise ValueError(
+                f"gen_kwargs max_length={self.gen_config.max_length} counts "
+                "decoder tokens incl. the start token; it must be >= 2 so "
+                "every rollout has at least one response token (a zero-"
+                "length response's terminal reward is silently dropped)"
+            )
+
     def _setup_model(self):
         from trlx_tpu.models.registry import get_model_family
 

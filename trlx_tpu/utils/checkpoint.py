@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import orbax.checkpoint as ocp
@@ -58,9 +59,15 @@ def save_checkpoint(
     mgr = _manager(directory)
     if step is None:
         step = (mgr.latest_step() or 0) + 1
+    # Stamp the save wall-clock so load_checkpoint can prefer the newest
+    # *timeline* over the highest step number: a crash between the new
+    # save's commit and stale-step GC below can leave a higher-numbered
+    # step from a previous run alongside this one.
     args = ocp.args.Composite(
         state=ocp.args.StandardSave(state),
-        host_state=ocp.args.JsonSave(metadata or {}),
+        host_state=ocp.args.JsonSave(
+            dict(metadata or {}, _saved_at=time.time())
+        ),
     )
     # A fresh run reusing a directory from a longer previous run: steps
     # beyond the one being written belong to the stale timeline and must go
@@ -126,6 +133,23 @@ def load_checkpoint(
         return state, metadata
     if step is None:
         raise FileNotFoundError(f"no checkpoint found under {directory}")
+    # Prefer the newest checkpoint by commit wall-clock, not step number:
+    # after a crash in save_checkpoint's commit->GC window, a stale
+    # higher-numbered step from a previous run can coexist with the newer
+    # save. Unstamped (legacy) steps sort by step number alone.
+    steps = sorted(mgr.all_steps())
+    if len(steps) > 1:
+
+        def _saved_at(s: int) -> float:
+            try:
+                meta = mgr.restore(
+                    s, args=ocp.args.Composite(host_state=ocp.args.JsonRestore())
+                )["host_state"]
+                return float((meta or {}).get("_saved_at", 0.0))
+            except Exception:
+                return 0.0
+
+        step = max(steps, key=lambda s: (_saved_at(s), s))
     restored = mgr.restore(
         step,
         args=ocp.args.Composite(
@@ -133,4 +157,6 @@ def load_checkpoint(
             host_state=ocp.args.JsonRestore(),
         ),
     )
-    return restored["state"], dict(restored["host_state"] or {})
+    metadata = dict(restored["host_state"] or {})
+    metadata.pop("_saved_at", None)
+    return restored["state"], metadata
