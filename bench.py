@@ -7,11 +7,15 @@ Workload (BASELINE.md): gpt2-small policy (124M, bf16), query length 64,
 (8 minibatches x 4 ppo_epochs). Weights are randomly initialized (zero-egress
 environment: no HF downloads) — identical compute to the pretrained model.
 
-The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is
-computed against a documented single-A100 estimate for torch trlX on this
-workload (HF generate rollouts + DDP updates): ~12 samples/s.
+The reference publishes no numbers (BASELINE.md), so the falsifiable
+claims here are the hardware-grounded ones: decode/train tokens/s,
+achieved FLOP/s, and MFU against the chip's published bf16 peak (FLOP
+accounting below). ``vs_baseline`` is kept for continuity against a
+documented single-A100 *estimate* for torch trlX on this workload
+(HF generate rollouts + DDP updates, ~12 samples/s) — an estimate, not a
+measurement.
 
-Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline", + extras}.
 """
 
 import json
@@ -22,6 +26,45 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 A100_BASELINE_SAMPLES_PER_SEC = 12.0
+
+# Published bf16 peak per chip by device_kind (dense, no sparsity).
+BF16_PEAK_TFLOPS = {
+    "TPU v3": 123.0,
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,  # v5e
+    "TPU v5": 459.0,  # v5p
+    "TPU v6 lite": 918.0,  # v6e (Trillium)
+}
+
+
+def _phase_flops(d, V, L, Q, R, B, ppo_epochs):
+    """Total matmul FLOPs for one PPO phase (collect + train), exact.
+
+    Trunk weights touched per token: qkv+proj (4 d^2) + mlp (8 d^2) per
+    layer. Attention scores/values: 4*d*c FLOPs per token at context
+    length c per layer (QK^T and AV, 2 FLOPs/MAC). The lm_head (d*V) is
+    counted only where the code actually applies it: the last prefill
+    position (`last_only` sampling), each decode step, and the R response
+    positions in ref scoring / training (`response_forward` slices hidden
+    to responses before the heads). Backward ~= 2x forward. Value head
+    and layernorms are negligible.
+    """
+    trunk = L * 12 * d * d
+    T = Q + R
+
+    def fwd(tokens, ctx_sum, head_tokens):
+        return 2 * trunk * tokens + 4 * L * d * ctx_sum + 2 * d * V * head_tokens
+
+    # collect: prefill over Q (logits at the last position only), R
+    # single-token decode steps at growing context, and the frozen-ref
+    # trunk forward over T with logits at the R response positions
+    prefill = fwd(Q, Q * (Q + 1) // 2, 1)
+    decode = fwd(R, sum(Q + t + 1 for t in range(R)), R)
+    ref = fwd(T, T * (T + 1) // 2, R)
+    collect = B * (prefill + decode + ref)
+    # train: ppo_epochs epochs of fwd+bwd (3x fwd) over T per sample
+    train = ppo_epochs * B * 3 * fwd(T, T * (T + 1) // 2, R)
+    return collect, train
 
 def main():
     import numpy as np
@@ -90,14 +133,25 @@ def main():
         trainer, pipeline, reward_fn=reward_fn, chunk_size=config.method.chunk_size
     )
 
-    def one_phase():
+    import jax
+
+    times = {"collect": 0.0, "train": 0.0}
+
+    def one_phase(record=False):
         trainer.buffer.clear_history()
+        t0 = time.time()
         orch.make_experience(config.method.num_rollouts, 0)
+        # make_experience ends on host-side reward work; the buffer is
+        # device-resident, so the collect/train split is the dispatch
+        # boundary here (train_on_buffer's block covers any tail)
+        t1 = time.time()
         # one fused dispatch for all minibatch x ppo_epoch updates
         trainer.train_on_buffer()
-        import jax
-
         jax.block_until_ready(trainer.state.params)
+        t2 = time.time()
+        if record:
+            times["collect"] += t1 - t0
+            times["train"] += t2 - t1
 
     one_phase()  # warmup: compile sampler + fused train phase
     one_phase()  # second warmup: absorbs any donated-buffer relayout retrace
@@ -105,14 +159,47 @@ def main():
     n_phases = 5
     start = time.time()
     for _ in range(n_phases):
-        one_phase()
+        one_phase(record=True)
     elapsed = time.time() - start
-
-    import jax
 
     n_chips = len(jax.devices())
     samples_per_sec = n_phases * config.method.num_rollouts / elapsed
     per_chip = samples_per_sec / n_chips
+
+    # hardware-grounded numbers: tokens/s per phase, FLOP/s, MFU
+    arch = config.model.model_arch
+    B, Q = config.method.num_rollouts, config.train.seq_length
+    R = config.method.gen_kwargs["max_new_tokens"]
+    collect_flops, train_flops = _phase_flops(
+        d=arch["n_embd"], V=arch["vocab_size"], L=arch["n_layer"],
+        Q=Q, R=R, B=B, ppo_epochs=config.method.ppo_epochs,
+    )
+    kind = jax.devices()[0].device_kind
+    peak = BF16_PEAK_TFLOPS.get(kind)
+    achieved_tflops = (
+        n_phases * (collect_flops + train_flops) / elapsed / n_chips / 1e12
+    )
+    extras = {
+        # generated tokens over the whole collect window (incl. prefill,
+        # frozen-ref forward, host reward) — rollout throughput, not a
+        # bare decode-step rate
+        "rollout_tok_per_sec_per_chip": round(
+            n_phases * B * R / times["collect"] / n_chips, 1
+        ),
+        "train_tok_per_sec_per_chip": round(
+            n_phases * config.method.ppo_epochs * B * (Q + R)
+            / times["train"] / n_chips,
+            1,
+        ),
+        "achieved_tflops_per_chip": round(achieved_tflops, 2),
+        "device_kind": kind,
+    }
+    if peak:
+        extras["mfu"] = round(achieved_tflops / peak, 4)
+        extras["bf16_peak_tflops"] = peak
+        extras["train_phase_mfu"] = round(
+            n_phases * train_flops / times["train"] / n_chips / 1e12 / peak, 4
+        )
 
     print(
         json.dumps(
@@ -121,6 +208,7 @@ def main():
                 "value": round(per_chip, 3),
                 "unit": "samples/s/chip",
                 "vs_baseline": round(per_chip / A100_BASELINE_SAMPLES_PER_SEC, 3),
+                **extras,
             }
         )
     )
