@@ -1,0 +1,233 @@
+"""Local stand-in for the reference's pretrained-checkpoint workloads.
+
+The reference's flagship examples start from real HF checkpoints —
+`examples/ppo_sentiments.py:23-54` (gpt2-imdb policy + distilbert-imdb
+sentiment reward) and `trlx/model/nn/ppo_models.py:610-615` (bf16
+AutoModelForSeq2SeqLM) — which a zero-egress environment cannot download.
+This module builds the same *shape* of workload entirely locally:
+
+1. pretrain a tiny LM with torch on a synthetic two-topic corpus (topic
+   persistence plays the role of "imdb style": a pretrained model
+   continues a prompt in the prompt's topic);
+2. save it HF-format (`save_pretrained`), exactly what a user points
+   ``model.model_path`` at;
+3. convert → shard → PPO-steer toward the "positive" topic with a
+   sentiment-classifier stand-in reward (token-set membership).
+
+Mean reward starts near 0 (continuations follow the prompt topic; half
+the prompts are negative) and rises as PPO shifts the policy positive —
+proving the convert → load → train path on real pretrained weights for
+both the causal (GPT-2) and seq2seq (T5) families.
+
+Run directly for the TPU demo: ``python examples/pretrained_standin.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# token-id layout (shared by both families; ids stay clear of T5's
+# pad=0 / eos=1 conventions)
+VOCAB = 64
+POS = list(range(2, 30))
+NEG = list(range(32, 60))
+EOS = 61
+PAD = 63
+
+
+def sample_docs(rng, n_docs: int, length: int) -> np.ndarray:
+    """Two-topic corpus: each doc draws every token iid from one topic's
+    token set. The only learnable structure is topic persistence."""
+    topics = rng.integers(0, 2, size=n_docs)
+    pos = rng.choice(POS, size=(n_docs, length))
+    neg = rng.choice(NEG, size=(n_docs, length))
+    return np.where(topics[:, None] == 1, pos, neg).astype(np.int64)
+
+
+def make_prompts(rng, n: int, length: int) -> list:
+    """Half positive-topic, half negative-topic prompts (balanced, unlike
+    sample_docs' random topic draw)."""
+    pos = rng.choice(POS, size=(n // 2, length))
+    neg = rng.choice(NEG, size=(n - n // 2, length))
+    docs = np.concatenate([pos, neg]).astype(np.int64)
+    rng.shuffle(docs)
+    return [list(map(int, row)) for row in docs]
+
+
+def sentiment_reward(samples, queries, response_gt=None):
+    """The distilbert-imdb stand-in: mean over response tokens of
+    +1 (positive set) / -1 (negative set) / 0 (other)."""
+    pos, neg = set(POS), set(NEG)
+    scores = []
+    for s in samples:
+        toks = [int(t) for t in s.split() if t.lstrip("-").isdigit()]
+        if not toks:
+            scores.append(0.0)
+            continue
+        scores.append(
+            sum((t in pos) - (t in neg) for t in toks) / len(toks)
+        )
+    return scores
+
+
+def pretrain_gpt2_checkpoint(
+    out_dir: str, steps: int = 400, batch: int = 64, length: int = 32,
+    seed: int = 0, log_every: int = 0,
+) -> str:
+    """Pretrain a tiny GPT-2 on the topic corpus with torch and save it in
+    HF format under ``out_dir`` (what `models/conversion.py` consumes)."""
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(seed)
+    rng = np.random.default_rng(seed)
+    config = GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=128, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        eos_token_id=EOS, bos_token_id=EOS,
+    )
+    model = GPT2LMHeadModel(config)
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+    model.train()
+    for step in range(steps):
+        ids = torch.from_numpy(sample_docs(rng, batch, length))
+        loss = model(input_ids=ids, labels=ids).loss
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        if log_every and (step + 1) % log_every == 0:
+            print(f"pretrain gpt2 step {step + 1}: loss {float(loss):.3f}")
+    model.eval()
+    model.save_pretrained(out_dir, safe_serialization=True)
+    return out_dir
+
+
+def pretrain_t5_checkpoint(
+    out_dir: str, steps: int = 400, batch: int = 64,
+    enc_len: int = 8, dec_len: int = 16, seed: int = 0, log_every: int = 0,
+) -> str:
+    """Pretrain a tiny T5 to continue the encoder prompt's topic in the
+    decoder, and save HF-format (`AutoModelForSeq2SeqLM`-loadable)."""
+    import torch
+    from transformers import T5Config, T5ForConditionalGeneration
+
+    torch.manual_seed(seed)
+    rng = np.random.default_rng(seed)
+    config = T5Config(
+        vocab_size=VOCAB, d_model=64, d_kv=16, d_ff=256,
+        num_layers=2, num_decoder_layers=2, num_heads=4,
+        relative_attention_num_buckets=8, relative_attention_max_distance=20,
+        dropout_rate=0.0, decoder_start_token_id=0,
+        eos_token_id=1, pad_token_id=0,
+    )
+    model = T5ForConditionalGeneration(config)
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
+    model.train()
+    for step in range(steps):
+        docs = sample_docs(rng, batch, enc_len + dec_len)
+        enc = torch.from_numpy(np.ascontiguousarray(docs[:, :enc_len]))
+        labels = torch.from_numpy(np.ascontiguousarray(docs[:, enc_len:]))
+        loss = model(input_ids=enc, labels=labels).loss
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        if log_every and (step + 1) % log_every == 0:
+            print(f"pretrain t5 step {step + 1}: loss {float(loss):.3f}")
+    model.eval()
+    model.save_pretrained(out_dir, safe_serialization=True)
+    return out_dir
+
+
+def _rl_config(model_path: str, family: str, **train_overrides) -> dict:
+    """Shared PPO config for both families; only the model selection,
+    trainer class, and special-token ids differ."""
+    causal = family == "gpt2"
+    gen_ids = (
+        {"eos_token_id": EOS, "pad_token_id": PAD}
+        if causal
+        else {"eos_token_id": 1, "pad_token_id": 0, "decoder_start_token_id": 0}
+    )
+    return {
+        "model": {"model_type": family, "model_path": model_path},
+        "train": {
+            "seq_length": 8,
+            "batch_size": 16,
+            "epochs": 12,
+            "total_steps": 96,
+            "eval_interval": 100000,
+            "checkpoint_interval": 1000000,
+            "lr_init": 1.0e-3,
+            "lr_target": 1.0e-3,
+            "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+            "dtype": "float32",
+            "seed": 3,
+            **({} if causal else {"trainer": "Seq2SeqPPOTrainer"}),
+            **train_overrides,
+        },
+        "method": {
+            "name": "PPOConfig",
+            "num_rollouts": 64,
+            "chunk_size": 64,
+            "ppo_epochs": 2,
+            "init_kl_coef": 0.005,
+            "scale_reward": None,
+            "gen_kwargs": {
+                "max_new_tokens": 12,
+                "min_new_tokens": 12,
+                "top_k": 0,
+                "do_sample": True,
+                **gen_ids,
+            },
+        },
+    }
+
+
+def causal_rl_config(model_path: str, **train_overrides) -> dict:
+    return _rl_config(model_path, "gpt2", **train_overrides)
+
+
+def seq2seq_rl_config(model_path: str, **train_overrides) -> dict:
+    return _rl_config(model_path, "t5", **train_overrides)
+
+
+def main():
+    os.environ.setdefault("WANDB_DISABLED", "1")
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    ckpt_dir = os.path.join(REPO, "ckpts", "standin_gpt2")
+    # key the cache on the weights file, not config.json: save_pretrained
+    # writes config.json first, so an interrupted save would otherwise be
+    # reused forever
+    if not os.path.exists(os.path.join(ckpt_dir, "model.safetensors")):
+        print("pretraining tiny gpt2 stand-in (torch, CPU)...")
+        pretrain_gpt2_checkpoint(ckpt_dir, log_every=100)
+
+    rng = np.random.default_rng(1)
+    prompts = make_prompts(rng, 256, 8)
+    means = []
+
+    def reward_fn(samples, queries, response_gt=None):
+        scores = sentiment_reward(samples, queries, response_gt)
+        means.append(float(np.mean(scores)))
+        return scores
+
+    trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        config=TRLConfig.from_dict(causal_rl_config(ckpt_dir)),
+    )
+    # reward_fn is also called by evaluate() at step 0 and at the end, so
+    # the first/last entries are full-eval means, not rollout phases
+    print("eval before:", round(means[0], 3), "-> after:", round(means[-1], 3))
+    print("rollout-phase curve:", [round(m, 3) for m in means[1:-1]])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""The pretrained-checkpoint path, end-to-end and offline.
+
+The reference's flagship workloads start from real HF checkpoints
+(`trlx/model/nn/ppo_models.py:610-615`, `examples/ppo_sentiments.py:23-54`);
+zero-egress makes those exact checkpoints unreachable, so these tests
+pretrain a tiny stand-in with torch, save it HF-format, and prove the full
+convert -> sharded load -> PPO-train path on *real pretrained weights* for
+both the causal (GPT-2) and seq2seq (T5) families:
+
+1. the converted policy exhibits the pretrained behavior (topic-persistent
+   continuations — not achievable from random init), and
+2. PPO from that checkpoint moves mean reward (a sentiment-classifier
+   stand-in) from ~0 toward positive.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "examples"))
+
+from pretrained_standin import (  # noqa: E402
+    NEG,
+    POS,
+    causal_rl_config,
+    make_prompts,
+    pretrain_gpt2_checkpoint,
+    pretrain_t5_checkpoint,
+    sentiment_reward,
+    seq2seq_rl_config,
+)
+
+
+def _topic_fraction(sample_out_tokens, mask, token_set):
+    toks = np.asarray(sample_out_tokens)
+    m = np.asarray(mask).astype(bool)
+    hits = np.isin(toks, list(token_set)) & m
+    return hits.sum() / max(m.sum(), 1)
+
+
+def _run_ppo(config_dict, reward_fn, prompts):
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    os.environ["WANDB_DISABLED"] = "1"
+    return trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=prompts,
+        config=TRLConfig.from_dict(config_dict),
+    )
+
+
+def _assert_reward_rose(means):
+    early = float(np.mean(means[:2]))
+    late = float(np.max(means[-3:]))
+    assert late > early + 0.2, (early, late, means)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "t5"])
+def test_pretrained_checkpoint_to_ppo(tmp_path, family):
+    import jax.numpy as jnp
+
+    ckpt = str(tmp_path / f"standin_{family}")
+    if family == "gpt2":
+        pretrain_gpt2_checkpoint(ckpt, steps=300)
+        config_dict = causal_rl_config(ckpt, total_steps=96, epochs=12)
+    else:
+        pretrain_t5_checkpoint(ckpt, steps=300)
+        config_dict = seq2seq_rl_config(ckpt, total_steps=96, epochs=12)
+
+    means = []
+
+    def reward_fn(samples, queries, response_gt=None):
+        scores = sentiment_reward(samples, queries, response_gt)
+        means.append(float(np.mean(scores)))
+        return scores
+
+    # Build the trainer first to probe the converted weights directly:
+    # continuations must follow the prompt's topic well above chance —
+    # impossible from random init, so this proves real pretrained weights
+    # survived conversion + sharded device_put.
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    probe_config = TRLConfig.from_dict(config_dict)
+    trainer = get_trainer(probe_config.train.trainer)(
+        probe_config, reward_fn=reward_fn
+    )
+    rng = np.random.default_rng(0)
+    B, Q = 16, 8
+    pos_prompts = jnp.asarray(rng.choice(POS, size=(B, Q)), jnp.int32)
+    neg_prompts = jnp.asarray(rng.choice(NEG, size=(B, Q)), jnp.int32)
+    ones = jnp.ones((B, Q), jnp.int32)
+    pos_out = trainer.sample(pos_prompts, ones)
+    neg_out = trainer.sample(neg_prompts, ones)
+    pos_frac = _topic_fraction(pos_out.tokens, pos_out.response_mask, POS)
+    neg_frac = _topic_fraction(neg_out.tokens, neg_out.response_mask, NEG)
+    assert pos_frac > 0.75, f"pos-topic continuation only {pos_frac:.2f}"
+    assert neg_frac > 0.75, f"neg-topic continuation only {neg_frac:.2f}"
+    # free the probe's params and compiled sampler before the real run
+    del trainer, pos_out, neg_out
+
+    # Now the actual workload: PPO from the checkpoint steers positive.
+    means.clear()
+    prompts = make_prompts(np.random.default_rng(1), 128, Q)
+    trained = _run_ppo(config_dict, reward_fn, prompts)
+    assert int(trained.state.step) == config_dict["train"]["total_steps"]
+    _assert_reward_rose(means)
