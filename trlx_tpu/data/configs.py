@@ -117,6 +117,9 @@ class TrainConfig:
     seed: int = 1000
 
     mesh: Dict[str, int] = field(default_factory=lambda: {"dp": -1, "fsdp": 1, "tp": 1})
+    # GPipe microbatches per batch shard when the mesh has a pp axis > 1
+    # (must divide batch_size / (dp * fsdp)); see models/pp_runner.py
+    pp_microbatches: int = 2
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
 

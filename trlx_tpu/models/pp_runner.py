@@ -1,0 +1,146 @@
+"""Pipeline-parallel trunk forward for the GPT-2 family.
+
+Integrates ``parallel/pipeline.py``'s GPipe primitive into the real model:
+the full-sequence forwards the PPO update runs (policy ``response_forward``
+and the frozen-ref scoring pass) route their transformer blocks through
+``pipeline_apply`` over the mesh's ``pp`` axis, with embeddings and heads
+running replicated over pp. This makes ``mesh: {dp: ..., pp: ...}`` a real
+training capability rather than a standalone demo (the reference has no pp
+at all — SURVEY §2.9 "PP: NO"; this is the beyond-parity axis).
+
+Scope and composition:
+- Stage s runs blocks ``[s*L/S, (s+1)*L/S)`` with an in-stage ``lax.scan``;
+  activations hop stages via ``ppermute`` (GPipe schedule, differentiable).
+- Param *residency* follows the existing fsdp/tp partition rules — pp
+  shards compute, fsdp shards memory; the two compose on one mesh.
+- Autoregressive decode keeps the standard GSPMD sampler (a KV cache
+  threaded through pipeline stages is a different schedule; decode under a
+  pp mesh runs the plain forward with params replicated over pp).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from trlx_tpu.models.gpt2 import Block, GPT2Config, GPT2Model
+from trlx_tpu.models.heads import MLPHead
+from trlx_tpu.ops.attention import causal_dispatch
+from trlx_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+
+def supports_pp(model_config) -> bool:
+    return isinstance(model_config, GPT2Config)
+
+
+def _stack_stages(block_params, stages: int):
+    """[L] per-block param trees -> leaves [S, L/S, ...] (stage-major)."""
+    per = len(block_params) // stages
+    stage_trees = [
+        jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs, axis=0),
+            *block_params[s * per : (s + 1) * per],
+        )
+        for s in range(stages)
+    ]
+    return stack_stage_params(stage_trees)
+
+
+def pp_hidden_forward(
+    config: GPT2Config,
+    backbone_params,
+    input_ids: jax.Array,  # [B, T]
+    attention_mask: jax.Array,  # [B, T]
+    mesh: Mesh,
+    num_microbatches: int = 2,
+) -> jax.Array:
+    """Full-sequence causal trunk forward (embed -> pp blocks -> ln_f),
+    numerically identical to ``GPT2Model.__call__`` with ``cache=None``.
+    Embedding / ln_f / heads reuse the flax module methods (one definition)
+    — only the block loop is replaced by the pipeline schedule."""
+    S = mesh.shape["pp"]
+    if config.n_layer % S:
+        raise ValueError(
+            f"n_layer={config.n_layer} must divide into pp={S} stages"
+        )
+    backbone = GPT2Model(config)
+    position_ids = jnp.clip(jnp.cumsum(attention_mask, axis=-1) - 1, 0, None)
+    x = backbone.apply(
+        {"params": backbone_params}, input_ids, position_ids,
+        method=GPT2Model.embed,
+    )
+    bias, causal = causal_dispatch(
+        input_ids.shape[1], None, None, attention_mask
+    )
+
+    stacked = _stack_stages(
+        [backbone_params[f"h_{i}"] for i in range(config.n_layer)], S
+    )
+    block = Block(config)
+
+    def stage_fn(stage_params, h, bias_mb):
+        def body(h, p):
+            h, _ = block.apply({"params": p}, h, bias_mb, causal=causal)
+            return h, None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    h = pipeline_apply(
+        stage_fn, stacked, x, mesh,
+        num_microbatches=num_microbatches, aux=bias,
+    )
+    return backbone.apply(
+        {"params": backbone_params}, h, method=lambda m, v: m.ln_f(v)
+    )
+
+
+def _logits(config: GPT2Config, backbone_params, hidden: jax.Array):
+    """Tied LM head on (already-sliced) hidden states via the module's own
+    definition (``GPT2Model.logits``)."""
+    return GPT2Model(config).apply(
+        {"params": backbone_params}, hidden, method=GPT2Model.logits
+    )
+
+
+def pp_response_forward(
+    config: GPT2Config,
+    params,  # CausalLMWithValueHead params: {"transformer", "v_head"}
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    query_length: int,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+):
+    """pp counterpart of ``CausalLMWithValueHead.response_forward``:
+    (logits, values) over the response-predicting positions Q-1..Q+R-2."""
+    h = pp_hidden_forward(
+        config, params["transformer"], input_ids, attention_mask,
+        mesh, num_microbatches,
+    )
+    hs = h[:, query_length - 1 : -1]
+    v_head = MLPHead(
+        config.n_embd, 1, dtype=config.dtype, param_dtype=config.param_dtype
+    )
+    values = v_head.apply({"params": params["v_head"]}, hs)[..., 0]
+    return _logits(config, params["transformer"], hs), values
+
+
+def pp_ref_logits(
+    config: GPT2Config,
+    backbone_params,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    query_length: int,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+) -> jax.Array:
+    """Frozen-reference logits over response-predicting positions (the
+    full-copy ref path; hydra's shared-trunk branch is not offered under
+    pp — the trunk capture point sits mid-pipeline)."""
+    h = pp_hidden_forward(
+        config, backbone_params, input_ids, attention_mask,
+        mesh, num_microbatches,
+    )
+    return _logits(config, backbone_params, h[:, query_length - 1 : -1])

@@ -41,6 +41,7 @@ def pipeline_apply(
     axis_name: str = "pp",
     num_microbatches: int = 2,
     batch_axes=("dp", "fsdp"),
+    aux=None,
 ) -> jax.Array:
     """Run ``x`` through S pipeline stages with M microbatches.
 
@@ -49,6 +50,12 @@ def pipeline_apply(
     ``stage_fn(params[s], h)``. ``num_microbatches`` must divide the
     *per-batch-shard* size ``x.shape[0] / (dp*fsdp)``. Returns activations
     after the last stage, with the same sharding as ``x``.
+
+    ``aux`` (optional): a pytree of batch-leading [B, ...] arrays carried
+    alongside the activations — e.g. an attention bias. Each stage receives
+    the microbatch slice matching the activations it is processing, as a
+    third argument: ``stage_fn(params, h, aux_mb)``. Unlike ``h``, aux does
+    not travel over the wire (every device holds its batch shard).
     """
     S = mesh.shape[axis_name]
     M = num_microbatches
@@ -67,7 +74,7 @@ def pipeline_apply(
             f"{M} microbatches"
         )
 
-    def local(params, x):
+    def local(params, x, aux):
         # params leaves arrive as [1, ...] (this device's stage); x is this
         # device's batch shard, replicated over the pp axis.
         params = jax.tree_util.tree_map(lambda p: p[0], params)
@@ -75,6 +82,9 @@ def pipeline_apply(
         n = jax.lax.psum(1, axis_name)
         b = x.shape[0]
         mbs = x.reshape((M, b // M) + x.shape[1:]).astype(x.dtype)
+        aux_mbs = jax.tree_util.tree_map(
+            lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:]), aux
+        )
 
         perm = [(i, (i + 1) % n) for i in range(n)]
         # carries must be pp-varying from the start (shard_map vma typing):
@@ -90,7 +100,11 @@ def pipeline_apply(
             m_c = jnp.clip(m, 0, M - 1)
             # stage 0 pulls from the microbatch stream; others from the wire
             h_in = jnp.where(idx == 0, mbs[m_c], buf)
-            h_out = stage_fn(params, h_in)
+            if aux is None:
+                h_out = stage_fn(params, h_in)
+            else:
+                aux_m = jax.tree_util.tree_map(lambda a: a[m_c], aux_mbs)
+                h_out = stage_fn(params, h_in, aux_m)
             # collect finished microbatches on the last stage
             outs = jnp.where(
                 jnp.logical_and(idx == n - 1, active),
@@ -115,9 +129,10 @@ def pipeline_apply(
         lambda _: P(axis_name), stacked_params
     )
     x_spec = P(batch_axes)
+    aux_specs = jax.tree_util.tree_map(lambda _: P(batch_axes), aux)
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs, x_spec),
+        in_specs=(param_specs, x_spec, aux_specs),
         out_specs=x_spec,
-    )(stacked_params, x)
+    )(stacked_params, x, aux)

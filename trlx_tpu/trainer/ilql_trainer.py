@@ -75,6 +75,13 @@ class ILQLTrainer(BaseRLTrainer):
         train = config.train
 
         self.mesh = make_mesh(train.mesh)
+        if dict(self.mesh.shape).get("pp", 1) > 1:
+            # without this guard a pp axis would silently replicate all
+            # compute across the pp devices (rules never reference pp)
+            raise NotImplementedError(
+                "pp mesh axis is integrated for the PPO GPT-2 path only; "
+                "ILQL supports dp/fsdp/tp"
+            )
         self.rng = set_seed(train.seed)
 
         if tokenizer is None and config.model.tokenizer_path:

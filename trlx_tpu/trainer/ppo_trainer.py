@@ -141,6 +141,29 @@ class PPOTrainer(BaseRLTrainer):
 
         init_params = self._setup_model()
 
+        # Pipeline parallelism: with a pp axis of size > 1, the PPO
+        # update's full-sequence forwards (policy response_forward + frozen
+        # ref) run the transformer blocks through the GPipe pipeline
+        # (`models/pp_runner.py`); embed/heads and the sampler run under
+        # plain GSPMD, replicated over pp.
+        self.pp_stages = dict(self.mesh.shape).get("pp", 1)
+        self.pp_microbatches = train.pp_microbatches
+        if self.pp_stages > 1:
+            from trlx_tpu.models.pp_runner import supports_pp
+
+            if not supports_pp(self.model_config):
+                raise NotImplementedError(
+                    f"pp mesh axis is integrated for the GPT-2 family only "
+                    f"(got {type(self.model_config).__name__}); use "
+                    f"dp/fsdp/tp/sp for other families"
+                )
+            if config.model.num_layers_unfrozen > 0:
+                raise NotImplementedError(
+                    "hydra shared-trunk KL reference (num_layers_unfrozen"
+                    " > 0) is not available under pp: the trunk capture "
+                    "point sits mid-pipeline; use the full-copy reference"
+                )
+
         gen_kwargs = dict(method.gen_kwargs)
         self.apply_tokenizer_gen_defaults(gen_kwargs)
         self._amend_gen_kwargs(gen_kwargs)
@@ -291,10 +314,18 @@ class PPOTrainer(BaseRLTrainer):
         Q = self.query_length
         full_ids = jnp.concatenate([mb.query_tokens, mb.response_tokens], axis=1)
         full_mask = jnp.concatenate([mb.query_mask, mb.response_mask], axis=1)
-        logits, values = self.model.apply(
-            {"params": params}, full_ids, full_mask, Q,
-            method=self.model.response_forward,
-        )
+        if self.pp_stages > 1:
+            from trlx_tpu.models.pp_runner import pp_response_forward
+
+            logits, values = pp_response_forward(
+                self.model_config, params, full_ids, full_mask, Q,
+                self.mesh, self.pp_microbatches,
+            )
+        else:
+            logits, values = self.model.apply(
+                {"params": params}, full_ids, full_mask, Q,
+                method=self.model.response_forward,
+            )
         logprobs = logprobs_from_logits(logits, mb.response_tokens)
         entropy = (
             _policy_entropy(logits) if self.config.method.ent_coef else None
@@ -314,6 +345,14 @@ class PPOTrainer(BaseRLTrainer):
         Q = self.query_length
         full_ids = jnp.concatenate([q_ids, r_ids], axis=1)
         full_mask = jnp.concatenate([q_mask, r_mask], axis=1)
+        if self.pp_stages > 1:
+            from trlx_tpu.models.pp_runner import pp_ref_logits
+
+            logits = pp_ref_logits(
+                self.model_config, ref_params, full_ids, full_mask, Q,
+                self.mesh, self.pp_microbatches,
+            )
+            return logprobs_from_logits(logits, r_ids)
         if self.use_hydra:
             trunk_out = self.backbone.apply(
                 {"params": policy_params[self.backbone_key]},
