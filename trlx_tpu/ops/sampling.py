@@ -171,6 +171,7 @@ def make_sampler(
     gen_config: GenerationConfig,
     query_length: int,
     with_values: bool = True,
+    cache_sharding=None,
 ):
     """Build a jittable ``(params, prompt_ids, prompt_mask, rng) ->
     SampleOutput`` closure.
@@ -179,10 +180,28 @@ def make_sampler(
     cache_index)`` must return a dict with "logits", "cache" and (if
     ``with_values``) "values". ``init_cache_fn(batch, capacity)`` builds the
     KV buffers.
+
+    ``cache_sharding`` (optional ``NamedSharding``): pins the KV buffers'
+    layout — e.g. ``P((dp, fsdp), "sp")`` to shard the *capacity* axis over
+    a sequence-parallel mesh axis, so long-context rollouts hold only
+    ``cap / sp`` of the cache per device. The decode attention over the
+    sharded cache is expressed normally; GSPMD inserts the cross-shard
+    softmax reduction (the collective moves [B, H, cap] logits, head_dim
+    times less than gathering the cache itself). Applied to the initial
+    buffers and re-pinned on each step's updated cache so the constraint
+    sticks through the scan carry.
     """
     Q = query_length
     R = gen_config.max_new_tokens
     cap = Q + R
+
+    def pin_cache(cache):
+        if cache_sharding is None:
+            return cache
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, cache_sharding),
+            cache,
+        )
     # Optional fast-prefill contract: an apply_fn accepting ``last_only``
     # may skip LM-head/value computation for all but the final position.
     import inspect
@@ -206,7 +225,7 @@ def make_sampler(
         else:
             min_new = None
 
-        cache = init_cache_fn(B, cap)
+        cache = pin_cache(init_cache_fn(B, cap))
         # prefill: cache validity = prompt mask over slots [0, Q)
         pad_tail = jnp.zeros((B, R), dtype=prompt_mask.dtype)
         cache_mask = jnp.concatenate([prompt_mask, pad_tail], axis=1)
@@ -220,7 +239,7 @@ def make_sampler(
             cache_index=0,
             **_prefill_kwargs,
         )
-        cache = out["cache"]
+        cache = pin_cache(out["cache"])
         logits_last = out["logits"][:, -1].astype(jnp.float32)  # [B, V]
         if with_values:
             value_last = out["values"][:, -1].astype(jnp.float32)
@@ -282,7 +301,7 @@ def make_sampler(
                 if with_values
                 else jnp.zeros((B,), jnp.float32)
             )
-            return (out["cache"], new_logits, new_value, finished, rng), ys
+            return (pin_cache(out["cache"]), new_logits, new_value, finished, rng), ys
 
         if gen_config.max_length > 0:
             # prompts already at/over the total-length cap emit no tokens
@@ -311,9 +330,16 @@ def make_seq2seq_sampler(
     init_cache_fn: Callable,
     gen_config: GenerationConfig,
     with_values: bool = True,
+    cache_sharding=None,
 ):
     """Compiled encoder-decoder sampling (the fork's T5 ``generate`` path,
     `ppo_models.py:620-622`, as one XLA program).
+
+    ``cache_sharding`` (optional ``NamedSharding``): shards the
+    cross-attention K/V's *encoder length* axis (dim 1) — the long-context
+    object for seq2seq rollouts — over a sequence-parallel mesh axis. The
+    decoder self-attn cache (capacity = generation length + 1) stays
+    replicated: it is short by construction.
 
     Encoder runs once; cross-attention K/V are precomputed per layer; the
     decoder scan feeds one token per step into a fixed-capacity self-attn
@@ -343,6 +369,11 @@ def make_seq2seq_sampler(
             min_new = None
         encoder_hidden = encode_fn(params, prompt_ids, prompt_mask)
         cross_kv = init_cross_kv_fn(params, encoder_hidden)
+        if cache_sharding is not None:
+            cross_kv = jax.tree_util.tree_map(
+                lambda a: jax.lax.with_sharding_constraint(a, cache_sharding),
+                cross_kv,
+            )
         cache = init_cache_fn(B, cap)
         slot_ids = jnp.arange(cap)[None, :]
 

@@ -114,6 +114,7 @@ class Seq2SeqPPOTrainer(PPOTrainer):
             functools.partial(init_t5_cache, self.model_config),
             self.gen_config,
             with_values=True,
+            cache_sharding=self._decode_cache_sharding(),
         )
 
     def _decoder_inputs(self, mb_response_tokens, mb_response_mask):
