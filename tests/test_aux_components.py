@@ -146,3 +146,27 @@ def test_tokenizer_gen_defaults_preserve_pad_zero():
     kwargs = {}
     Host2().apply_tokenizer_gen_defaults(kwargs)
     assert kwargs == {"eos_token_id": 7, "pad_token_id": 7}
+
+
+def test_logger_tqdm_progress_line(monkeypatch):
+    """Interactive runs get a tqdm progress line on stderr with live
+    loss/reward (reference `accelerate_base_model.py:245-297`); JSON on
+    stdout stays untouched."""
+    import io
+    import sys
+
+    from trlx_tpu.utils.logging import Logger
+
+    class TtyIO(io.StringIO):
+        def isatty(self):
+            return True
+
+    fake_err = TtyIO()
+    monkeypatch.setattr(sys, "stderr", fake_err)
+    out = io.StringIO()
+    logger = Logger(use_wandb=False, stream=out, total_steps=10)
+    logger.log({"losses/total_loss": 0.5, "reward/mean": 1.25}, step=3)
+    logger.finish()
+    bar = fake_err.getvalue()
+    assert "3/10" in bar and "total_loss" in bar, bar
+    assert "reward/mean" in out.getvalue()  # JSON side unaffected

@@ -323,6 +323,7 @@ class ILQLTrainer(BaseRLTrainer):
             run_name=train.run_name,
             config=self.config.to_dict(),
             tags=train.tags,
+            total_steps=total_steps,
         )
         self.logger = logger
         try:

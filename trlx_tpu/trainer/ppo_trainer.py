@@ -593,6 +593,7 @@ class PPOTrainer(BaseRLTrainer):
             run_name=train.run_name,
             config=self.config.to_dict(),
             tags=train.tags,
+            total_steps=total_steps,
         )
         self.logger = logger
         self._profiling = False

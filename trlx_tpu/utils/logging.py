@@ -28,10 +28,16 @@ class Logger:
         tags=(),
         use_wandb: Optional[bool] = None,
         stream=None,
+        total_steps: Optional[int] = None,
     ):
         self.stream = stream or sys.stdout
         self.start = time.time()
         self._wandb = None
+        # interactive tqdm progress line (reference shows a tqdm bar with a
+        # live loss description, `accelerate_base_model.py:245-297`);
+        # stderr-only, so stdout's JSON lines stay machine-parseable
+        self._pbar = None
+        self._total_steps = total_steps
         # rank-0 gating on multi-host pods (reference gates trackers on
         # accelerator.is_main_process, `accelerate_base_model.py:78`)
         from trlx_tpu.parallel.distributed import is_main_process
@@ -69,9 +75,35 @@ class Logger:
             stats = {**stats, **jax.device_get(device_vals)}
         scalars = filter_non_scalars(stats)
         record = {"step": step, "time": round(time.time() - self.start, 2), **scalars}
+        if self._pbar is not None:
+            # erase the live bar first: stdout and stderr often share the
+            # terminal, and printing at the bar's cursor garbles both
+            self._pbar.clear()
         print(json.dumps(record, default=float), file=self.stream, flush=True)
         if self._wandb is not None:
             self._wandb.log(scalars, step=step)
+        self._update_progress(step, scalars)
+
+    def _update_progress(self, step, scalars) -> None:
+        if not (hasattr(sys.stderr, "isatty") and sys.stderr.isatty()):
+            return
+        if self._pbar is None:
+            try:
+                from tqdm import tqdm
+            except ImportError:
+                return
+            self._pbar = tqdm(
+                total=self._total_steps, desc="train", dynamic_ncols=True
+            )
+        if step is not None:
+            self._pbar.n = int(step)
+        postfix = {}
+        for key in ("losses/total_loss", "reward/mean", "exp/score_mean"):
+            if key in scalars:
+                postfix[key.split("/")[-1]] = f"{float(scalars[key]):.4f}"
+        if postfix:
+            self._pbar.set_postfix(postfix, refresh=False)
+        self._pbar.refresh()
 
     def log_samples(self, rows, columns, step: Optional[int] = None) -> None:
         """Log generated-sample tables (reference wandb Table,
@@ -93,5 +125,8 @@ class Logger:
                 pass
 
     def finish(self) -> None:
+        if self._pbar is not None:
+            self._pbar.close()
+            self._pbar = None
         if self._wandb is not None:
             self._wandb.finish()
