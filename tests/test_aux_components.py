@@ -155,6 +155,7 @@ def test_logger_tqdm_progress_line(monkeypatch):
     import io
     import sys
 
+    pytest.importorskip("tqdm")
     from trlx_tpu.utils.logging import Logger
 
     class TtyIO(io.StringIO):

@@ -110,6 +110,8 @@ class Logger:
         `accelerate_base_model.py:180-221`); stdout shows the first rows."""
         if not self.is_main:
             return
+        if self._pbar is not None:
+            self._pbar.clear()  # same terminal-sharing guard as log()
         for row in rows[:4]:
             printable = {c: str(v)[:120] for c, v in zip(columns, row)}
             print(json.dumps({"sample": printable}, default=str), file=self.stream)
