@@ -53,38 +53,65 @@ def main(overrides: dict | None = None):
 
     texts, labels = load_imdb()
     tokenizer = None
+    eval_prompts = [t.split()[0] if t else "the" for t in texts[:32]]
+    this_metric_fn = metric_fn
     if not os.path.isdir(config.model.model_path):
-        # zero-egress: from-scratch small model + whitespace word-id tokenizer
-        config.model.model_path = ""
+        # Stand-in tier (zero-egress): the reference workload's shape — a
+        # genuinely *pretrained* policy + reward-labeled offline dataset +
+        # sentiment metric — built locally (examples/pretrained_standin.py).
+        # Positive/negative topic docs play imdb text+label; ILQL learns to
+        # steer the pretrained topic prior positive at eval decode.
+        import numpy as np
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from pretrained_standin import (
+            EOS,
+            NEG,
+            PAD,
+            POS,
+            ensure_gpt2_checkpoint,
+            make_prompts,
+            sentiment_reward,
+        )
+
+        config.model.model_path = ensure_gpt2_checkpoint(repo)
         config.model.tokenizer_path = ""
-        vocab = sorted({w for t in texts for w in t.lower().split()})
-        word_to_id = {w: i + 2 for i, w in enumerate(vocab)}
-
-        class WordTokenizer:
-            pad_token_id = 0
-            eos_token_id = 1
-
-            def encode(self, text):
-                return [word_to_id.get(w, 0) for w in text.lower().split()]
-
-            def decode(self, ids, skip_special_tokens=True):
-                id_to_word = {v: k for k, v in word_to_id.items()}
-                return " ".join(id_to_word.get(int(i), "?") for i in ids)
-
-        tokenizer = WordTokenizer()
-        config.model.model_arch = {
-            "vocab_size": len(vocab) + 2, "n_positions": 64,
-            "n_embd": 64, "n_layer": 2, "n_head": 4,
-        }
-        config.update(train={"total_steps": 20, "batch_size": 16})
+        rng = np.random.default_rng(0)
+        # pre-tokenized (tokens, action_start): 8 prompt tokens (random
+        # topic) + 8 continuation tokens whose topic is drawn INDEPENDENTLY
+        # — the offline data must contain topic switches, or ILQL has no
+        # evidence that steering positive from a negative prompt pays
+        # (CQL correctly suppresses never-observed actions)
+        n = 256
+        prompt_topic = rng.integers(0, 2, size=n)
+        cont_topic = rng.integers(0, 2, size=n)
+        pick = lambda topic, m: rng.choice(POS if topic else NEG, size=m)
+        texts = [
+            (
+                [int(t) for t in pick(prompt_topic[i], 8)]
+                + [int(t) for t in pick(cont_topic[i], 8)],
+                8,
+            )
+            for i in range(n)
+        ]
+        # label = sentiment of the continuation (what ILQL should maximize)
+        labels = [float(cont_topic[i]) for i in range(n)]
+        eval_prompts = make_prompts(rng, 32, 8)
         config.method.gen_kwargs = {
-            "max_new_tokens": 12, "eos_token_id": 1, "pad_token_id": 0,
+            "max_new_tokens": 8, "eos_token_id": EOS, "pad_token_id": PAD,
         }
+        config.update(train={"total_steps": 400, "epochs": 30, "batch_size": 16,
+                             "seq_length": 16})
+        if overrides:
+            config.update(**overrides)  # caller overrides beat tier defaults
+
+        def this_metric_fn(samples):  # noqa: F811
+            return {"sentiment": sentiment_reward(samples, None, None)}
 
     trainer = trlx_tpu.train(
         dataset=(texts, labels),
-        metric_fn=metric_fn,
-        eval_prompts=[t.split()[0] if t else "the" for t in texts[:32]],
+        metric_fn=this_metric_fn,
+        eval_prompts=eval_prompts,
         config=config,
         tokenizer=tokenizer,
     )

@@ -79,31 +79,34 @@ def main(overrides: dict | None = None):
         config.update(**overrides)
 
     model_ok = os.path.isdir(config.model.model_path)
-    if not model_ok:
-        # from-scratch gpt2-small shape, bundled prompts, lexicon reward
-        config.model.model_path = ""
-        config.model.tokenizer_path = ""
-        config.model.model_arch = {
-            "vocab_size": 50257, "n_positions": 1024,
-            "n_embd": 768, "n_layer": 12, "n_head": 12,
-        }
-
-    sentiment_path = os.environ.get("SENTIMENT_MODEL_PATH")
-    reward_fn = make_sentiment_fn(sentiment_path)
-
     if model_ok:
+        reward_fn = make_sentiment_fn(os.environ.get("SENTIMENT_MODEL_PATH"))
         prompts = PROMPT_STUBS * 16
     else:
+        # Stand-in tier (zero-egress): the same workload *shape* as the
+        # reference — a genuinely pretrained policy steered by a sentiment
+        # classifier — built locally (examples/pretrained_standin.py:
+        # torch-pretrained two-topic LM, saved HF-format, converted).
+        # Mean reward rises from ~0 as PPO shifts the topic prior positive.
         import numpy as np
 
-        rng = np.random.default_rng(0)
-        prompts = [
-            list(rng.integers(100, 40000, size=rng.integers(4, 16)))
-            for _ in range(256)
-        ]
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from pretrained_standin import (
+            causal_rl_config,
+            ensure_gpt2_checkpoint,
+            make_prompts,
+            sentiment_reward,
+        )
 
-        def reward_fn(samples, queries=None, response_gt=None):  # noqa: F811
-            return [len(set(s)) / max(len(s), 1) for s in samples]
+        config = TRLConfig.from_dict(
+            causal_rl_config(ensure_gpt2_checkpoint(repo))
+        )
+        if overrides:
+            config.update(**overrides)
+        prompts = make_prompts(np.random.default_rng(0), 256, 8)
+
+        def reward_fn(samples, queries=None, response_gt=None):
+            return sentiment_reward(samples, queries, response_gt)
 
     trainer = trlx_tpu.train(
         reward_fn=reward_fn, prompts=prompts, config=config

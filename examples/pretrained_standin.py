@@ -196,18 +196,24 @@ def seq2seq_rl_config(model_path: str, **train_overrides) -> dict:
     return _rl_config(model_path, "t5", **train_overrides)
 
 
+def ensure_gpt2_checkpoint(repo: str = REPO) -> str:
+    """Pretrain the shared stand-in checkpoint once under ``ckpts/``.
+    The cache is keyed on the weights file, not config.json:
+    save_pretrained writes config.json first, so an interrupted save
+    would otherwise be reused forever."""
+    ckpt_dir = os.path.join(repo, "ckpts", "standin_gpt2")
+    if not os.path.exists(os.path.join(ckpt_dir, "model.safetensors")):
+        print("pretraining tiny gpt2 stand-in (torch, CPU)...")
+        pretrain_gpt2_checkpoint(ckpt_dir, log_every=100)
+    return ckpt_dir
+
+
 def main():
     os.environ.setdefault("WANDB_DISABLED", "1")
     import trlx_tpu
     from trlx_tpu.data.configs import TRLConfig
 
-    ckpt_dir = os.path.join(REPO, "ckpts", "standin_gpt2")
-    # key the cache on the weights file, not config.json: save_pretrained
-    # writes config.json first, so an interrupted save would otherwise be
-    # reused forever
-    if not os.path.exists(os.path.join(ckpt_dir, "model.safetensors")):
-        print("pretraining tiny gpt2 stand-in (torch, CPU)...")
-        pretrain_gpt2_checkpoint(ckpt_dir, log_every=100)
+    ckpt_dir = ensure_gpt2_checkpoint()
 
     rng = np.random.default_rng(1)
     prompts = make_prompts(rng, 256, 8)

@@ -74,3 +74,22 @@ def test_char_tokenizer_roundtrip():
     tok = CharTokenizer()
     text = "take(reverse(x), 3)"
     assert tok.decode(tok.encode(text)) == text
+
+
+def test_sentiments_standin_tiers_run():
+    """Both sentiment examples' zero-egress stand-in tiers (pretrained local
+    policy + classifier stand-in reward/metric) run end-to-end on the CPU
+    mesh; the shared checkpoint is pretrained once under ckpts/."""
+    os.environ["WANDB_DISABLED"] = "1"
+    import ilql_sentiments
+    import ppo_sentiments
+
+    stats = ppo_sentiments.main(
+        overrides={"train": {"total_steps": 8, "epochs": 1}}
+    )
+    assert "reward/mean" in stats, stats
+
+    stats = ilql_sentiments.main(
+        overrides={"train": {"total_steps": 8, "epochs": 1}}
+    )
+    assert "metrics/sentiment" in stats, stats
