@@ -1,0 +1,168 @@
+"""Switch-MoE GPT-2 family: expert parallelism as a training capability
+(8-dev CPU mesh).
+
+Round-1 review: ep existed only as a generic token-routing primitive.
+These tests prove the integrated capability — a GPT-2 variant whose MoE
+blocks shard experts over the ``ep`` axis matches its dense-execution
+path exactly (forward + gradients, with capacity_factor high enough that
+nothing drops), and a full PPO run on a dp x fsdp x ep mesh learns.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _config(mesh, **train_overrides):
+    from trlx_tpu.data.configs import TRLConfig
+
+    return TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2_moe",
+                "model_arch": {
+                    "vocab_size": 16,
+                    "n_positions": 16,
+                    "n_embd": 32,
+                    "n_layer": 2,
+                    "n_head": 2,
+                    "n_experts": 4,
+                    "moe_every": 2,
+                    # >= n_experts => no capacity drops: sharded == dense
+                    "capacity_factor": 4.0,
+                },
+            },
+            "train": {
+                "seq_length": 4,
+                "batch_size": 16,
+                "epochs": 2,
+                "total_steps": 8,
+                "eval_interval": 1000,
+                "checkpoint_interval": 100000,
+                "lr_init": 1.0e-3,
+                "lr_target": 1.0e-3,
+                "mesh": mesh,
+                "dtype": "float32",
+                "seed": 7,
+                **train_overrides,
+            },
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 32,
+                "chunk_size": 32,
+                "ppo_epochs": 2,
+                "init_kl_coef": 0.001,
+                "scale_reward": None,
+                "gen_kwargs": {
+                    "max_new_tokens": 4,
+                    "min_new_tokens": 4,
+                    "top_k": 0,
+                    "do_sample": True,
+                    "eos_token_id": 14,
+                    "pad_token_id": 15,
+                },
+            },
+        }
+    )
+
+
+def test_moe_sharded_matches_dense():
+    """The ep-sharded switch path == the dense all-experts path (same
+    params, generous capacity): logits, values, and gradients."""
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+
+    from trlx_tpu.models import gpt2_moe
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    config = _config({"dp": 2, "fsdp": 2, "tp": 1, "ep": 2})
+    trainer = get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+    params = jax.device_get(trainer.state.params)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, 13, (16, 8)), jnp.int32)
+    mask = jnp.ones((16, 8), jnp.int32)
+
+    def fwd(p):
+        out = trainer.model.apply({"params": p}, ids, attention_mask=mask)
+        return out["logits"].astype(jnp.float32), out["values"]
+
+    def loss(p):
+        logits, values = fwd(p)
+        return jnp.mean(logits**2) + jnp.mean(values**2)
+
+    # sharded path (ep mesh installed by the trainer)
+    assert gpt2_moe._EP_MESH is not None
+    sh_logits, sh_values = jax.jit(fwd)(params)
+    g_sh = jax.jit(jax.grad(loss))(params)
+
+    # dense path: clear the mesh and retrace
+    gpt2_moe.set_ep_mesh(None)
+    try:
+        de_logits, de_values = jax.jit(fwd)(params)
+        g_de = jax.jit(jax.grad(loss))(params)
+    finally:
+        gpt2_moe.set_ep_mesh(trainer.mesh)
+
+    np.testing.assert_allclose(
+        np.asarray(sh_logits), np.asarray(de_logits), atol=1e-4, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(sh_values), np.asarray(de_values), atol=1e-4, rtol=1e-4
+    )
+    f_sh, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_sh))
+    f_de, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_de))
+    np.testing.assert_allclose(
+        np.asarray(f_sh), np.asarray(f_de), atol=1e-4, rtol=1e-3
+    )
+
+
+def test_e2e_ppo_trains_on_dp_fsdp_ep_mesh():
+    """Full PPO over dp=2 x fsdp=2 x ep=2 with the switch-MoE policy;
+    reward on a trivially learnable task rises and experts stay sharded."""
+    os.environ["WANDB_DISABLED"] = "1"
+    import jax
+
+    import trlx_tpu
+
+    means = []
+
+    def reward_fn(samples, queries, response_gt=None):
+        scores = [sum(tok == "5" for tok in s.split()) / 4 for s in samples]
+        means.append(float(np.mean(scores)))
+        return scores
+
+    config = _config(
+        {"dp": 2, "fsdp": 2, "tp": 1, "ep": 2},
+        epochs=12, total_steps=48,  # 12 epochs x 4 updates/epoch
+    )
+    prompts = [[1, 2, 3, 4]] * 64
+    trainer = trlx_tpu.train(reward_fn=reward_fn, prompts=prompts, config=config)
+    assert int(trainer.state.step) == 48
+    early = float(np.mean(means[:2]))
+    late = float(np.max(means[-4:]))
+    assert late > early + 0.15, (early, late, means)
+    # expert params are genuinely ep-sharded at rest
+    wi = trainer.state.params["transformer"]["h_1"]["mlp"]["wi"]
+    assert "ep" in wi.sharding.spec, wi.sharding.spec
+
+
+def test_ep_axis_rejects_dense_families():
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    config = _config({"dp": -1, "fsdp": 1, "tp": 1, "ep": 2})
+    config.model.model_type = "gpt2"
+    config.model.model_arch = {
+        "vocab_size": 16, "n_positions": 16, "n_embd": 32,
+        "n_layer": 2, "n_head": 2,
+    }
+    with pytest.raises(NotImplementedError, match="MoE"):
+        get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])

@@ -23,6 +23,8 @@ class ModelFamily:
     init_cache: Callable  # (config, batch, capacity) -> cache
     load_checkpoint: Callable  # (path, dtype) -> (config, params)
     is_seq2seq: bool = False
+    # has switch-MoE experts an `ep` mesh axis can shard (models/gpt2_moe.py)
+    supports_ep: bool = False
 
 
 _FAMILIES: Dict[str, ModelFamily] = {}
@@ -118,4 +120,18 @@ def _register_builtins() -> None:
             conversion.load_t5_checkpoint, is_seq2seq=True,
         ),
         "ul2",
+    )
+    from trlx_tpu.models.gpt2_moe import (
+        GPT2MoEConfig,
+        GPT2MoEModel,
+        GPT2_MOE_PARTITION_RULES,
+        _no_checkpoint,
+    )
+
+    register_model_family(
+        ModelFamily(
+            "gpt2_moe", GPT2MoEConfig, GPT2MoEModel, GPT2_MOE_PARTITION_RULES,
+            init_cache, _no_checkpoint, supports_ep=True,
+        ),
+        "gpt2-moe",
     )

@@ -36,26 +36,36 @@ def moe_apply(
     mesh: Mesh,
     axis_name: str = "ep",
     capacity_factor: float = 1.25,
+    batch_axes: tuple = (),
 ) -> jax.Array:
     """Route each token through its top-1 expert; returns [N, D].
 
     ``E`` (leading dim of the expert params) must be divisible by the ``ep``
     axis size. Dropped (over-capacity) tokens return zeros.
+
+    ``batch_axes``: extra mesh axes the token dim is *also* sharded over
+    (e.g. ``("dp", "fsdp")`` inside a training step) — each data-parallel
+    group then runs its own expert exchange, with the ``all_to_all`` riding
+    only the ``ep`` axis. Without it, tokens are treated as replicated over
+    those axes (every device would redo the full batch).
     """
     E = jax.tree_util.tree_leaves(stacked_expert_params)[0].shape[0]
     ep = mesh.shape[axis_name]
     if E % ep:
         raise ValueError(f"{E} experts not divisible by ep={ep}")
     N = x.shape[0]
-    if N % ep:
-        raise ValueError(f"{N} tokens not divisible by ep={ep}")
-    n_loc = N // ep
+    n_shards = ep * int(np.prod([mesh.shape[a] for a in batch_axes]))
+    if N % n_shards:
+        raise ValueError(f"{N} tokens not divisible by {n_shards} shards")
+    n_loc = N // n_shards
     C = int(np.ceil(capacity_factor * n_loc / E))  # per (device, expert)
 
     def local(params, x, router_w):
         # x: [n_loc, D] local tokens; params leaves: [E/ep, ...]
-        logits = x @ router_w.astype(x.dtype)  # [n_loc, E]
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        # routing in float32: near-tied logits must argmax identically to
+        # any dense-execution twin of this layer regardless of x.dtype
+        logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
         expert = jnp.argmax(probs, axis=-1)  # [n_loc]
         gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
 
@@ -89,9 +99,10 @@ def moe_apply(
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), stacked_expert_params
     )
+    tok_spec = P((*batch_axes, axis_name)) if batch_axes else P(axis_name)
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs, P(axis_name), P()),
-        out_specs=P(axis_name),
+        in_specs=(param_specs, tok_spec, P()),
+        out_specs=tok_spec,
     )(stacked_expert_params, x, router_w)

@@ -77,8 +77,10 @@ def make_partition_specs(
             if re.search(pattern, name):
                 for i, ax in enumerate(pspec):
                     if ax is not None and i < len(shape):
-                        # Apply tp placement only if it divides and tp > 1.
-                        if tp > 1 and shape[i] % tp == 0:
+                        # Apply the rule's axis (tp, ep, ...) only if that
+                        # axis exists with size > 1 and divides the dim.
+                        n_ax = dict(mesh.shape).get(ax, 1)
+                        if n_ax > 1 and shape[i] % n_ax == 0:
                             base[i] = ax
                 break
         size = 1

@@ -81,6 +81,30 @@ class BaseRLTrainer(ABC):
             "do_save": step > 0 and step % t.checkpoint_interval == 0,
         }
 
+    def setup_ep_axis(self, mesh, family) -> None:
+        """Validate + install expert parallelism for this trainer's model.
+
+        An ``ep`` mesh axis is only meaningful for families with switch-MoE
+        experts (``ModelFamily.supports_ep``); for any other family the
+        axis would silently replicate all compute, so reject it loudly. For
+        MoE families, install the mesh as the module-level ep context
+        (`models/gpt2_moe.py::set_ep_mesh`) — call this *after* parameter
+        init (so init traces the dense path with no token-divisibility
+        constraints) and *before* building jitted programs. One active MoE
+        trainer per process: a second MoE trainer re-points the context.
+        """
+        ep = dict(mesh.shape).get("ep", 1)
+        if ep > 1 and not getattr(family, "supports_ep", False):
+            raise NotImplementedError(
+                f"ep mesh axis requires an MoE family (supports_ep); "
+                f"{family.name!r} has no experts to shard — the axis would "
+                "silently replicate all compute"
+            )
+        if getattr(family, "supports_ep", False):
+            from trlx_tpu.models import gpt2_moe
+
+            gpt2_moe.set_ep_mesh(mesh)
+
     def check_anomalies(self, stats: Dict[str, Any], step: int) -> None:
         """Abort with a clear error when fetched loss stats go non-finite
         (``train.detect_anomalies``; beyond the reference — SURVEY §5.3

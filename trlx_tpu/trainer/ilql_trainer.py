@@ -152,6 +152,7 @@ class ILQLTrainer(BaseRLTrainer):
         )
 
         self.store = None  # installed by OfflineOrchestrator
+        self.setup_ep_axis(self.mesh, self.family)
         self._build_jitted_fns()
 
     def _shardings_for(self, tree):

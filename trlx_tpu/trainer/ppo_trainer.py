@@ -238,6 +238,7 @@ class PPOTrainer(BaseRLTrainer):
         self.kl_coef = float(method.init_kl_coef)
         self.mean_kl = 0.0
 
+        self.setup_ep_axis(self.mesh, self.family)
         self._build_jitted_fns()
 
     # ----------------------- model-family hooks ----------------------- #
