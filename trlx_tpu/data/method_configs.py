@@ -46,6 +46,7 @@ def get_method(name: str) -> type:
         # `trlx/utils/loading.py:1-16` import-time registration)
         import trlx_tpu.ops.ilql_math  # noqa: F401
         import trlx_tpu.ops.ppo_math  # noqa: F401
+        import trlx_tpu.trainer.grpo_trainer  # noqa: F401  (GRPOConfig)
     if key in _METHODS:
         return _METHODS[key]
     raise ValueError(f"Unknown method config: {name!r}. Registered: {sorted(_METHODS)}")

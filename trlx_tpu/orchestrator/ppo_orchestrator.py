@@ -55,9 +55,18 @@ class PPOOrchestrator(Orchestrator):
         super().__init__(trainer, pipeline)
         self.reward_fn = reward_fn
         self.chunk_size = chunk_size
+        # chunk_size counts ROLLOUTS per chunk; a grouped-baseline trainer
+        # (GRPO) turns each drawn prompt into group_size rollouts, so the
+        # loader draws chunk_size / G prompts per chunk
+        G = int(getattr(trainer, "group_size", 1) or 1)
+        if chunk_size % G:
+            raise ValueError(
+                f"chunk_size={chunk_size} must be a multiple of "
+                f"group_size={G} (each prompt yields {G} rollouts)"
+            )
         self._loader = infinite_loader(
             lambda seed: pipeline.create_loader(
-                chunk_size, shuffle=True, seed=seed, drop_last=False
+                chunk_size // G, shuffle=True, seed=seed, drop_last=False
             )
         )
         # running reward scaling state (`ppo_orchestrator.py:49-51`)
@@ -69,6 +78,29 @@ class PPOOrchestrator(Orchestrator):
         # pid suffix: two jobs sharing a rollout_logging_dir that start in
         # the same second must still get distinct run directories
         self._run_id = f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+
+    def _expand_groups(self, batch, meta):
+        """Grouped-baseline support (GRPO): when the trainer declares
+        ``group_size`` G > 1, repeat each prompt G times *within the chunk*
+        so same-prompt rollouts are contiguous — the trainer's reward
+        shaping normalizes scores within each group before anything is
+        shuffled."""
+        G = int(getattr(self.trainer, "group_size", 1) or 1)
+        if G <= 1:
+            return batch, meta
+        import jax.numpy as jnp
+
+        batch = type(batch)(
+            input_ids=jnp.repeat(batch.input_ids, G, axis=0),
+            attention_mask=jnp.repeat(batch.attention_mask, G, axis=0),
+        )
+        meta = {
+            k: ([x for x in v for _ in range(G)] if isinstance(v, list) else v)
+            for k, v in meta.items()
+        }
+        if "n_real" in meta:
+            meta["n_real"] = meta["n_real"] * G
+        return batch, meta
 
     def score(self, samples, queries, response_gt):
         """User reward fn call (host Python; `ppo_orchestrator.py:53-57`)."""
@@ -99,6 +131,7 @@ class PPOOrchestrator(Orchestrator):
         without waiting on it. Dispatch is async; the results are consumed
         later, after the *previous* chunk's host-side scoring."""
         batch, meta = next(self._loader)
+        batch, meta = self._expand_groups(batch, meta)
         t = Clock()
         sample_out = self.trainer.sample(batch.input_ids, batch.attention_mask)
         dispatch_ms = t.tick()

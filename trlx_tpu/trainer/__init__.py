@@ -43,6 +43,7 @@ def register_trainer(name=None):
 def get_trainer(name: str) -> type:
     key = name.lower()
     if key not in _TRAINERS:
+        import trlx_tpu.trainer.grpo_trainer  # noqa: F401
         import trlx_tpu.trainer.ilql_trainer  # noqa: F401
         import trlx_tpu.trainer.ppo_trainer  # noqa: F401
         import trlx_tpu.trainer.seq2seq_ppo_trainer  # noqa: F401

@@ -1,0 +1,82 @@
+"""GRPO: group-relative PPO without a value function (beyond parity).
+
+The reference ships classic PPO only; this adds the grouped-baseline
+variant modern RLHF stacks favor for its memory profile — no value head
+training, no GAE. Per prompt, ``group_size`` rollouts are sampled (the
+orchestrator repeats each chunk prompt G times, contiguously —
+`orchestrator/ppo_orchestrator.py::_expand_groups`); each rollout's
+KL-shaped return is normalized against its own group:
+
+    A_i = (R_i − mean_group) / (std_group + 1e-6)
+
+broadcast over the response tokens, and optimized with the same clipped
+surrogate (``vf_coef`` defaults to 0 so the value head, while still
+present in the model, receives no training signal). Group advantages are
+computed at experience time and stored in the rollout buffer's rewards
+slot, so minibatch shuffling can never split a group.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from trlx_tpu.data.method_configs import register_method
+from trlx_tpu.data.ppo_types import PPORolloutBatch
+from trlx_tpu.ops.ppo_math import PPOConfig
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+
+@register_method
+@dataclass
+class GRPOConfig(PPOConfig):
+    """PPO hyperparameters + the group size; GAE (gamma/lam) and the value
+    loss are unused — ``vf_coef`` defaults to 0."""
+
+    name: str = "GRPOConfig"
+    group_size: int = 8
+    vf_coef: float = 0.0
+
+
+@register_trainer
+class GRPOTrainer(PPOTrainer):
+    def __init__(self, config, **kw):
+        method: GRPOConfig = config.method
+        if method.group_size < 2:
+            raise ValueError(
+                f"GRPO needs group_size >= 2 (got {method.group_size}): a "
+                "single-rollout group has zero-variance baseline"
+            )
+        if method.vf_coef:
+            raise ValueError(
+                f"GRPO has no value function (vf_coef={method.vf_coef}); "
+                "the returns slot carries a placeholder, so a nonzero "
+                "vf_coef would regress values onto stale rollout values"
+            )
+        super().__init__(config, **kw)
+        # the orchestrator reads this to repeat prompts within each chunk
+        self.group_size = int(method.group_size)
+
+    def _shape_rewards(self, logprobs, ref_logprobs, response_mask, scores, kl_coef):
+        """Store group-normalized per-sequence advantages (broadcast over
+        response tokens) in the rewards slot; rows arrive group-contiguous
+        from the orchestrator's expansion."""
+        rewards, mean_kl = super()._shape_rewards(
+            logprobs, ref_logprobs, response_mask, scores, kl_coef
+        )
+        G = self.group_size
+        returns = jnp.sum(rewards, axis=1)  # KL-regularized return R_i
+        grouped = returns.reshape(-1, G)
+        mean = jnp.mean(grouped, axis=1, keepdims=True)
+        std = jnp.std(grouped, axis=1, keepdims=True)
+        adv = ((grouped - mean) / (std + 1e-6)).reshape(-1)
+        maskf = response_mask.astype(jnp.float32)
+        return adv[:, None] * maskf, mean_kl
+
+    def _advantages_and_returns(self, mb: PPORolloutBatch):
+        """No GAE: mb.rewards already holds the group-normalized advantage
+        per token. Returns are set to the stored values so the (zero-
+        weighted) value loss is exactly zero rather than noise."""
+        return mb.rewards, mb.values

@@ -129,6 +129,16 @@ class PPOTrainer(BaseRLTrainer):
 
         self.mesh = make_mesh(train.mesh)
         self.rng = set_seed(train.seed)
+        from trlx_tpu.trainer.grpo_trainer import GRPOConfig, GRPOTrainer
+
+        if isinstance(method, GRPOConfig) and not isinstance(self, GRPOTrainer):
+            # GRPO needs the grouped sampler expansion + advantage path;
+            # running its config through plain PPO would silently train
+            # classic PPO with vf_coef=0 on ungrouped rollouts
+            raise ValueError(
+                "method GRPOConfig requires `train.trainer: GRPOTrainer` "
+                f"(got {type(self).__name__})"
+            )
 
         if tokenizer is None and config.model.tokenizer_path:
             from transformers import AutoTokenizer
@@ -397,6 +407,27 @@ class PPOTrainer(BaseRLTrainer):
 
     # ------------------------------------------------------------------ #
 
+    def _shape_rewards(self, logprobs, ref_logprobs, response_mask, scores, kl_coef):
+        """Per-token shaped rewards: −kl_coef·KL with the terminal score at
+        the last valid slot (reference `ppo_orchestrator.py:163-167`).
+        Jitted by ``_build_jitted_fns``; subclasses may post-process (GRPO
+        stores group-normalized advantages here instead)."""
+        maskf = response_mask.astype(jnp.float32)
+        kl_per_token = (logprobs - ref_logprobs) * maskf
+        rewards = -kl_coef * kl_per_token
+        last = jnp.clip(jnp.sum(response_mask, axis=1) - 1, 0, None)
+        rewards = rewards.at[jnp.arange(rewards.shape[0]), last].add(scores)
+        mean_kl = jnp.mean(jnp.sum(kl_per_token, axis=1))
+        return rewards, mean_kl
+
+    def _advantages_and_returns(self, mb: PPORolloutBatch):
+        """(advantages, returns) for the PPO loss — GAE over the stored
+        values/rewards by default; traced inside the jitted train step."""
+        method: PPOConfig = self.config.method
+        return get_advantages_and_returns(
+            mb.values, mb.rewards, mb.response_mask, method.gamma, method.lam
+        )
+
     def _shardings_for(self, tree):
         specs = make_partition_specs(tree, self.mesh, self.partition_rules)
         return jax.tree_util.tree_map(
@@ -429,17 +460,8 @@ class PPOTrainer(BaseRLTrainer):
             out_shardings=batch_sh,
         )
 
-        def compute_rewards(logprobs, ref_logprobs, response_mask, scores, kl_coef):
-            maskf = response_mask.astype(jnp.float32)
-            kl_per_token = (logprobs - ref_logprobs) * maskf
-            rewards = -kl_coef * kl_per_token
-            last = jnp.clip(jnp.sum(response_mask, axis=1) - 1, 0, None)
-            rewards = rewards.at[jnp.arange(rewards.shape[0]), last].add(scores)
-            mean_kl = jnp.mean(jnp.sum(kl_per_token, axis=1))
-            return rewards, mean_kl
-
         self._compute_rewards_jit = jax.jit(
-            compute_rewards,
+            self._shape_rewards,
             in_shardings=(batch_sh, batch_sh, batch_sh, batch_sh, rep),
             out_shardings=(batch_sh, rep),
         )
@@ -449,9 +471,7 @@ class PPOTrainer(BaseRLTrainer):
                 logprobs, values, entropy = self._forward_logprobs_values(
                     params, mb
                 )
-                advantages, returns = get_advantages_and_returns(
-                    mb.values, mb.rewards, mb.response_mask, method.gamma, method.lam
-                )
+                advantages, returns = self._advantages_and_returns(mb)
                 return ppo_loss(
                     logprobs,
                     values,
