@@ -129,10 +129,18 @@ def pretrain_t5_checkpoint(
     model = T5ForConditionalGeneration(config)
     opt = torch.optim.AdamW(model.parameters(), lr=1e-3)
     model.train()
+    reps = -(-dec_len // enc_len)  # ceil: echo labels span all of dec_len
     for step in range(steps):
         docs = sample_docs(rng, batch, enc_len + dec_len)
         enc = torch.from_numpy(np.ascontiguousarray(docs[:, :enc_len]))
-        labels = torch.from_numpy(np.ascontiguousarray(docs[:, enc_len:]))
+        if step % 3 == 0:
+            # echo objective: decode the encoder tokens back (tiled to
+            # dec_len) — gives the model cross-attention copy circuitry,
+            # so downstream RL toward echo-style ground truths
+            # (examples/rl_ul2.py stand-in tier) has a reachable target
+            labels = enc.repeat(1, reps)[:, :dec_len]
+        else:
+            labels = torch.from_numpy(np.ascontiguousarray(docs[:, enc_len:]))
         loss = model(input_ids=enc, labels=labels).loss
         opt.zero_grad()
         loss.backward()
@@ -205,6 +213,15 @@ def ensure_gpt2_checkpoint(repo: str = REPO) -> str:
     if not os.path.exists(os.path.join(ckpt_dir, "model.safetensors")):
         print("pretraining tiny gpt2 stand-in (torch, CPU)...")
         pretrain_gpt2_checkpoint(ckpt_dir, log_every=100)
+    return ckpt_dir
+
+
+def ensure_t5_checkpoint(repo: str = REPO) -> str:
+    """Seq2seq counterpart of :func:`ensure_gpt2_checkpoint`."""
+    ckpt_dir = os.path.join(repo, "ckpts", "standin_t5")
+    if not os.path.exists(os.path.join(ckpt_dir, "model.safetensors")):
+        print("pretraining tiny t5 stand-in (torch, CPU)...")
+        pretrain_t5_checkpoint(ckpt_dir, log_every=100)
     return ckpt_dir
 
 

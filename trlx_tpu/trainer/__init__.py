@@ -82,6 +82,20 @@ class BaseRLTrainer(ABC):
             "do_save": step > 0 and step % t.checkpoint_interval == 0,
         }
 
+    def _decode_cache_sharding(self):
+        """KV-cache sharding for the compiled samplers: with an ``sp`` mesh
+        axis > 1 the cache's *capacity* axis (causal) or the cross-KV's
+        encoder-length axis (seq2seq) shards over sp, so long-context
+        rollouts hold 1/sp of the cache per device (the training-side
+        counterpart is ring attention, `ops/ring_attention.py`)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from trlx_tpu.parallel.mesh import BATCH_AXES
+
+        if dict(self.mesh.shape).get("sp", 1) <= 1:
+            return None
+        return NamedSharding(self.mesh, PartitionSpec(BATCH_AXES, "sp"))
+
     def setup_ep_axis(self, mesh, family) -> None:
         """Validate + install expert parallelism for this trainer's model.
 

@@ -278,6 +278,7 @@ class ILQLTrainer(BaseRLTrainer):
             self.gen_config,
             self.query_length,
             with_values=False,
+            cache_sharding=self._decode_cache_sharding(),
         )
         bundle_shardings = {
             "params": self.param_shardings,

@@ -315,18 +315,6 @@ class PPOTrainer(BaseRLTrainer):
             cache_sharding=self._decode_cache_sharding(),
         )
 
-    def _decode_cache_sharding(self):
-        """KV-cache sharding for the compiled sampler: with an ``sp`` mesh
-        axis > 1 the cache's *capacity* axis shards over sp, so
-        long-context rollouts hold cap/sp of the cache per device (the
-        training-side counterpart is ring attention, `ops/ring_attention.py`;
-        this makes sp cover generation too)."""
-        if dict(self.mesh.shape).get("sp", 1) <= 1:
-            return None
-        from trlx_tpu.parallel.mesh import BATCH_AXES
-
-        return NamedSharding(self.mesh, P(BATCH_AXES, "sp"))
-
     def _forward_logprobs_values(self, params, mb: PPORolloutBatch):
         """Policy forward -> (logprobs, values, entropy?) over response
         positions.
