@@ -112,3 +112,50 @@ def test_grpo_config_requires_grpo_trainer():
     config = _config(group_size=1)
     with pytest.raises(ValueError, match="group_size"):
         get_trainer("GRPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+
+
+def test_ppo_group_whitened_rewards_learn():
+    """Classic PPO (value head + GAE) with grouped sampling and per-group
+    score whitening (scale_reward "group") — the variance-reduction
+    variant; reward on the learnable task still rises."""
+    os.environ["WANDB_DISABLED"] = "1"
+    import trlx_tpu
+
+    means = []
+
+    def reward_fn(samples, queries, response_gt=None):
+        scores = [sum(tok == "5" for tok in s.split()) / 6 for s in samples]
+        means.append(float(np.mean(scores)))
+        return scores
+
+    from trlx_tpu.ops.ppo_math import PPOConfig
+
+    config = _config(group_size=4)
+    config.train.trainer = "PPOTrainer"
+    config.method = PPOConfig.from_dict(
+        {**config.method.to_dict(), "name": "PPOConfig",
+         "scale_reward": "group", "vf_coef": 1.0}
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=[[1, 2, 3, 4]] * 64, config=config
+    )
+    assert int(trainer.state.step) == 48
+    early = float(np.mean(means[:2]))
+    late = float(np.max(means[-4:]))
+    assert late > early + 0.15, (early, late, means)
+
+
+def test_group_scale_requires_group_size():
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    from trlx_tpu.ops.ppo_math import PPOConfig
+
+    config = _config(group_size=4)
+    config.train.trainer = "PPOTrainer"
+    config.method = PPOConfig.from_dict(
+        {**config.method.to_dict(), "name": "PPOConfig",
+         "scale_reward": "group", "group_size": 1, "vf_coef": 1.0}
+    )
+    with pytest.raises(ValueError, match="group"):
+        get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])

@@ -41,7 +41,7 @@ class PPOConfig(MethodConfig):
     :param gamma / lam: GAE discounting.
     :param cliprange / cliprange_value: PPO clipping.
     :param vf_coef: value-loss weight.
-    :param scale_reward: "running" | "ref" | None.
+    :param scale_reward: "running" | "ref" | "group" | None ("group" whitens scores within each same-prompt group; needs group_size >= 2).
     :param cliprange_reward: clip scores to +-this after scaling.
     :param gen_kwargs: generation params (max_new_tokens, top_k, top_p,
         temperature, do_sample).
@@ -61,6 +61,10 @@ class PPOConfig(MethodConfig):
     vf_coef: float = 1.0
     # entropy-bonus weight (beyond parity; 0 = exact reference loss)
     ent_coef: float = 0.0
+    # rollouts sampled per prompt (beyond parity; the orchestrator repeats
+    # each chunk prompt this many times, contiguously). With > 1,
+    # scale_reward "group" whitens scores within each same-prompt group.
+    group_size: int = 1
     scale_reward: Optional[str] = None
     ref_mean: Optional[float] = None
     ref_std: Optional[float] = None

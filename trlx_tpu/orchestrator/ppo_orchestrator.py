@@ -55,18 +55,20 @@ class PPOOrchestrator(Orchestrator):
         super().__init__(trainer, pipeline)
         self.reward_fn = reward_fn
         self.chunk_size = chunk_size
-        # chunk_size counts ROLLOUTS per chunk; a grouped-baseline trainer
-        # (GRPO) turns each drawn prompt into group_size rollouts, so the
-        # loader draws chunk_size / G prompts per chunk
-        G = int(getattr(trainer, "group_size", 1) or 1)
-        if chunk_size % G:
+        # chunk_size counts ROLLOUTS per chunk; a grouped trainer (GRPO, or
+        # PPO with method.group_size > 1) turns each drawn prompt into
+        # group_size rollouts, so the loader draws chunk_size / G prompts
+        self.group_size = int(getattr(trainer, "group_size", 1) or 1)
+        if chunk_size % self.group_size:
             raise ValueError(
                 f"chunk_size={chunk_size} must be a multiple of "
-                f"group_size={G} (each prompt yields {G} rollouts)"
+                f"group_size={self.group_size} (each prompt yields "
+                f"{self.group_size} rollouts)"
             )
         self._loader = infinite_loader(
             lambda seed: pipeline.create_loader(
-                chunk_size // G, shuffle=True, seed=seed, drop_last=False
+                chunk_size // self.group_size, shuffle=True, seed=seed,
+                drop_last=False,
             )
         )
         # running reward scaling state (`ppo_orchestrator.py:49-51`)
@@ -85,7 +87,7 @@ class PPOOrchestrator(Orchestrator):
         so same-prompt rollouts are contiguous — the trainer's reward
         shaping normalizes scores within each group before anything is
         shuffled."""
-        G = int(getattr(self.trainer, "group_size", 1) or 1)
+        G = self.group_size
         if G <= 1:
             return batch, meta
         import jax.numpy as jnp
@@ -214,6 +216,14 @@ class PPOOrchestrator(Orchestrator):
                     scores = scores / self.running.std
             elif method.scale_reward == "ref" and self.ref_std:
                 scores = scores / self.ref_std
+            elif method.scale_reward == "group":
+                # whiten within each same-prompt group (beyond parity;
+                # rows are group-contiguous via _expand_groups)
+                grouped = scores.reshape(-1, self.group_size)
+                scores = (
+                    (grouped - grouped.mean(axis=1, keepdims=True))
+                    / (grouped.std(axis=1, keepdims=True) + 1e-6)
+                ).reshape(-1)
             if method.cliprange_reward:
                 scores = np.clip(
                     scores, -method.cliprange_reward, method.cliprange_reward

@@ -55,9 +55,8 @@ class GRPOTrainer(PPOTrainer):
                 "the returns slot carries a placeholder, so a nonzero "
                 "vf_coef would regress values onto stale rollout values"
             )
-        super().__init__(config, **kw)
-        # the orchestrator reads this to repeat prompts within each chunk
-        self.group_size = int(method.group_size)
+        super().__init__(config, **kw)  # sets self.group_size (read by the
+        # orchestrator to repeat prompts within each chunk)
 
     def _shape_rewards(self, logprobs, ref_logprobs, response_mask, scores, kl_coef):
         """Store group-normalized per-sequence advantages (broadcast over

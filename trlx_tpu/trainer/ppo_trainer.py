@@ -129,6 +129,16 @@ class PPOTrainer(BaseRLTrainer):
 
         self.mesh = make_mesh(train.mesh)
         self.rng = set_seed(train.seed)
+        # grouped sampling (orchestrator repeats each chunk prompt G times);
+        # scale_reward "group" whitens scores within each group. Validated
+        # before any model construction — config errors should be instant.
+        self.group_size = int(getattr(method, "group_size", 1) or 1)
+        if method.scale_reward == "group" and self.group_size < 2:
+            raise ValueError(
+                'scale_reward "group" needs method.group_size >= 2 '
+                f"(got {self.group_size})"
+            )
+
         from trlx_tpu.trainer.grpo_trainer import GRPOConfig, GRPOTrainer
 
         if isinstance(method, GRPOConfig) and not isinstance(self, GRPOTrainer):
