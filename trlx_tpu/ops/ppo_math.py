@@ -74,6 +74,18 @@ class PPOConfig(MethodConfig):
     )
 
 
+def group_whiten(values, group_size: int):
+    """Normalize within contiguous groups of ``group_size``:
+    (v - group_mean) / (group_std + 1e-6). Works on host numpy arrays and
+    traced jnp arrays alike (method-dispatch ops only) — the single
+    definition of "group whitening" shared by GRPO advantages and PPO's
+    ``scale_reward: "group"``."""
+    grouped = values.reshape(-1, group_size)
+    mean = grouped.mean(axis=1, keepdims=True)
+    std = grouped.std(axis=1, keepdims=True)
+    return ((grouped - mean) / (std + 1e-6)).reshape(-1)
+
+
 def get_advantages_and_returns(
     values: jax.Array,  # [B, R]
     rewards: jax.Array,  # [B, R]

@@ -219,11 +219,9 @@ class PPOOrchestrator(Orchestrator):
             elif method.scale_reward == "group":
                 # whiten within each same-prompt group (beyond parity;
                 # rows are group-contiguous via _expand_groups)
-                grouped = scores.reshape(-1, self.group_size)
-                scores = (
-                    (grouped - grouped.mean(axis=1, keepdims=True))
-                    / (grouped.std(axis=1, keepdims=True) + 1e-6)
-                ).reshape(-1)
+                from trlx_tpu.ops.ppo_math import group_whiten
+
+                scores = group_whiten(scores, self.group_size)
             if method.cliprange_reward:
                 scores = np.clip(
                     scores, -method.cliprange_reward, method.cliprange_reward

@@ -27,6 +27,7 @@ from trlx_tpu.data.ppo_types import PPORolloutBatch
 from trlx_tpu.ops.ppo_math import PPOConfig
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+from trlx_tpu.trainer.seq2seq_ppo_trainer import Seq2SeqPPOTrainer
 
 
 @register_method
@@ -40,8 +41,11 @@ class GRPOConfig(PPOConfig):
     vf_coef: float = 0.0
 
 
-@register_trainer
-class GRPOTrainer(PPOTrainer):
+class GRPOMixin:
+    """The GRPO behavior as a mixin over any PPO-family trainer: grouped
+    chunk sampling (via ``self.group_size``), group-normalized advantages
+    stored at experience time, and no value-function training."""
+
     def __init__(self, config, **kw):
         method: GRPOConfig = config.method
         if method.group_size < 2:
@@ -65,17 +69,28 @@ class GRPOTrainer(PPOTrainer):
         rewards, mean_kl = super()._shape_rewards(
             logprobs, ref_logprobs, response_mask, scores, kl_coef
         )
-        G = self.group_size
+        from trlx_tpu.ops.ppo_math import group_whiten
+
         returns = jnp.sum(rewards, axis=1)  # KL-regularized return R_i
-        grouped = returns.reshape(-1, G)
-        mean = jnp.mean(grouped, axis=1, keepdims=True)
-        std = jnp.std(grouped, axis=1, keepdims=True)
-        adv = ((grouped - mean) / (std + 1e-6)).reshape(-1)
+        adv = group_whiten(returns, self.group_size)
         maskf = response_mask.astype(jnp.float32)
         return adv[:, None] * maskf, mean_kl
 
     def _advantages_and_returns(self, mb: PPORolloutBatch):
         """No GAE: mb.rewards already holds the group-normalized advantage
-        per token. Returns are set to the stored values so the (zero-
-        weighted) value loss is exactly zero rather than noise."""
+        per token. Returns are set to the stored values so the value loss
+        starts at zero and stays zero-WEIGHTED (vf_coef=0); the logged
+        vf_loss stat drifts nonzero as shared-backbone updates move the
+        (untrained) value head — that is expected, not a grouping bug."""
         return mb.rewards, mb.values
+
+
+@register_trainer
+class GRPOTrainer(GRPOMixin, PPOTrainer):
+    """GRPO over the causal PPO path."""
+
+
+@register_trainer
+class Seq2SeqGRPOTrainer(GRPOMixin, Seq2SeqPPOTrainer):
+    """GRPO over the fork's T5/UL2 seq2seq path (decoder rollouts grouped
+    per encoder prompt)."""

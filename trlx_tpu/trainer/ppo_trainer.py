@@ -139,15 +139,15 @@ class PPOTrainer(BaseRLTrainer):
                 f"(got {self.group_size})"
             )
 
-        from trlx_tpu.trainer.grpo_trainer import GRPOConfig, GRPOTrainer
+        from trlx_tpu.trainer.grpo_trainer import GRPOConfig, GRPOMixin
 
-        if isinstance(method, GRPOConfig) and not isinstance(self, GRPOTrainer):
+        if isinstance(method, GRPOConfig) and not isinstance(self, GRPOMixin):
             # GRPO needs the grouped sampler expansion + advantage path;
             # running its config through plain PPO would silently train
             # classic PPO with vf_coef=0 on ungrouped rollouts
             raise ValueError(
-                "method GRPOConfig requires `train.trainer: GRPOTrainer` "
-                f"(got {type(self).__name__})"
+                "method GRPOConfig requires a GRPO trainer (GRPOTrainer / "
+                f"Seq2SeqGRPOTrainer); got {type(self).__name__}"
             )
 
         if tokenizer is None and config.model.tokenizer_path:
