@@ -2,6 +2,8 @@
 double-buffered collection loop (reference `ppo_orchestrator.py:96-112`,
 first-batch ref-stat seeding `:97-98`, chunked loop `:66-196`)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -179,3 +181,47 @@ def test_rollout_logging_dir_writes_jsonl(tmp_path):
     assert len(rows) == 8
     assert {"query", "response", "score"} <= set(rows[0])
     assert rows[0]["score"] == 1.5
+
+
+def test_eval_reward_receives_response_gt():
+    """Evaluation passes ground truths to the reward fn when eval falls
+    back to the training prompts (reference `accelerate_base_model.py:193`
+    passes response_gt at eval; previously eval saw response_gt=None and
+    gt-based rewards read as zero)."""
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    os.environ["WANDB_DISABLED"] = "1"
+    seen_gts = []
+
+    def reward_fn(samples, queries, response_gt=None):
+        seen_gts.append(response_gt)
+        return [0.0 if response_gt is None else 1.0] * len(samples)
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {"model_type": "gpt2", "model_arch": {
+                "vocab_size": 32, "n_positions": 16, "n_embd": 16,
+                "n_layer": 1, "n_head": 2}},
+            "train": {
+                "seq_length": 4, "batch_size": 8, "epochs": 1,
+                "total_steps": 2, "eval_interval": 1000,
+                "checkpoint_interval": 100000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1}, "dtype": "float32",
+            },
+            "method": {
+                "name": "PPOConfig", "num_rollouts": 16, "chunk_size": 8,
+                "ppo_epochs": 1,
+                "gen_kwargs": {"max_new_tokens": 2, "do_sample": True,
+                               "eos_token_id": 30, "pad_token_id": 31},
+            },
+        }
+    )
+    prompts = [[1, 2, 3]] * 16
+    gts = [f"gt-{i}" for i in range(16)]
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, response_gt=gts, config=config
+    )
+    # every call — rollout chunks AND the initial/final evals — saw gts
+    assert seen_gts and all(g is not None for g in seen_gts), seen_gts
+    assert any(g and g[0].startswith("gt-") for g in seen_gts)

@@ -86,11 +86,18 @@ def train(
         )
         orch.make_experience(config.method.num_rollouts, 0)
 
-        eval_pipeline = get_pipeline(config.train.pipeline)(
-            eval_prompts if eval_prompts is not None else prompts,
-            trainer.query_length,
-            trainer.tokenizer,
-        )
+        if eval_prompts is None:
+            # reuse the training pipeline (same prompts, same ground
+            # truths — the reference's eval passes response_gt to the
+            # reward fn, `accelerate_base_model.py:193`); create_loader
+            # returns independent generators, so sharing the object is safe
+            # and skips a second tokenize/decode pass over every prompt
+            eval_pipeline = pipeline
+        else:
+            # caller-supplied eval prompts carry no aligned gt list
+            eval_pipeline = get_pipeline(config.train.pipeline)(
+                eval_prompts, trainer.query_length, trainer.tokenizer
+            )
         trainer.add_eval_pipeline(eval_pipeline)
         trainer.learn()
         return trainer
