@@ -280,3 +280,33 @@ def test_ilql_gen_defaults_are_config_visible():
     assert cfg2.gen_kwargs["top_k"] == 5
     assert cfg2.gen_kwargs["max_new_tokens"] == 48
     assert cfg2.gen_kwargs["do_sample"] is True
+
+
+def test_ilql_trainer_merges_gen_defaults_for_direct_assignment():
+    """ADVICE r2 low: code that assigns config.method.gen_kwargs directly
+    (bypassing ILQLConfig.from_dict's merge, as examples do) must still get
+    the reference eval-decode defaults (top_k=20) under its own keys."""
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "model_arch": {"vocab_size": 16, "n_positions": 16,
+                               "n_embd": 32, "n_layer": 2, "n_head": 2},
+            },
+            "train": {
+                "seq_length": 8, "batch_size": 16, "epochs": 1,
+                "total_steps": 2, "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "float32", "trainer": "ILQLTrainer",
+                "orchestrator": "OfflineOrchestrator",
+            },
+            "method": {"name": "ILQLConfig"},
+        }
+    )
+    config.method.gen_kwargs = {"max_new_tokens": 4, "eos_token_id": 14,
+                                "pad_token_id": 15}
+    trainer = get_trainer("ILQLTrainer")(config)
+    assert trainer.gen_config.top_k == 20  # default survived
+    assert trainer.gen_config.max_new_tokens == 4  # user key won

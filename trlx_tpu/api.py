@@ -84,7 +84,6 @@ def train(
             reward_fn=reward_fn,
             chunk_size=config.method.chunk_size,
         )
-        orch.make_experience(config.method.num_rollouts, 0)
 
         if eval_prompts is None:
             # reuse the training pipeline (same prompts, same ground
@@ -98,7 +97,11 @@ def train(
             eval_pipeline = get_pipeline(config.train.pipeline)(
                 eval_prompts, trainer.query_length, trainer.tokenizer
             )
+        # bind eval BEFORE the first collection: add_eval_pipeline may
+        # expand the decode budget (bind_prompt_budget), and doing so after
+        # make_experience would discard the just-compiled sampler
         trainer.add_eval_pipeline(eval_pipeline)
+        orch.make_experience(config.method.num_rollouts, 0)
         trainer.learn()
         return trainer
 

@@ -59,6 +59,12 @@ class GPT2MoEConfig:
     n_experts: int = 4
     moe_every: int = 2  # blocks 1, 1+k, ... use the switch MLP
     capacity_factor: float = 2.0
+    # load-balancing regularizers (training only; see SwitchMLP): the
+    # Switch-Transformer auxiliary loss (α, paper §2.2 uses 0.01) keeps
+    # top-1 routing from collapsing onto few experts once capacity drops
+    # are real, and the ST-MoE router z-loss bounds router logit growth
+    router_aux_coef: float = 0.01
+    router_z_coef: float = 0.001
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
 
@@ -90,7 +96,43 @@ class SwitchMLP(nn.Module):
 
         shape = x.shape
         toks = x.reshape(-1, D).astype(dtype)
-        mesh = _EP_MESH
+
+        # Router pass over the full token set, in float32 (near-tied logits
+        # must argmax identically to the sharded twin of this layer).
+        logits = toks.astype(jnp.float32) @ router.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+        expert = jnp.argmax(probs, axis=-1)  # [N]
+
+        # Load-balancing regularizers, sown for the trainer's loss when it
+        # opens the "moe_losses" collection (training forwards only; the
+        # sampler applies immutably, where sow is a no-op):
+        # - Switch-Transformer aux loss (§2.2): E · Σ_e f_e·P_e, with f_e
+        #   the fraction of tokens argmax-routed to expert e (no gradient,
+        #   as the paper prescribes) and P_e the mean router probability
+        #   (carries the gradient). Uniform routing gives the minimum 1.
+        # - ST-MoE router z-loss: mean(logsumexp(logits)²) bounds logit
+        #   growth, keeping the f32 softmax sharp but stable.
+        # - max_load: busiest expert's token fraction (diagnostic; 1/E is
+        #   perfect balance, ~1 is router collapse).
+        if self.is_mutable_collection("moe_losses"):
+            frac = jnp.mean(
+                jax.nn.one_hot(expert, E, dtype=jnp.float32), axis=0
+            )
+            pmean = jnp.mean(probs, axis=0)
+            self.sow("moe_losses", "aux_loss", E * jnp.sum(frac * pmean))
+            self.sow(
+                "moe_losses", "router_z",
+                jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2),
+            )
+            self.sow("moe_losses", "max_load", jnp.max(frac))
+
+        # Single-token forwards (T == 1, a static trace-time property —
+        # exactly the sampler's decode steps) always take the dense path:
+        # per-step token count is only B, so sharded per-device expert
+        # capacity ceil(cf·B/(dp·fsdp·ep)/E) rounds to ~1 and routing
+        # imbalance would silently zero dropped tokens' MLP output
+        # mid-rollout; dense at B tokens is cheap and exact.
+        mesh = _EP_MESH if shape[1] > 1 else None
         if mesh is not None:
             from trlx_tpu.parallel.moe import moe_apply
 
@@ -108,9 +150,6 @@ class SwitchMLP(nn.Module):
                 batch_axes=("dp", "fsdp"),
             )
         else:
-            logits = (toks.astype(jnp.float32) @ router.astype(jnp.float32))
-            probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
-            expert = jnp.argmax(probs, axis=-1)  # [N]
             gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)
             h = jnp.einsum("nd,edf->enf", toks, wi.astype(dtype))
             h = nn.gelu(h + bi.astype(dtype)[:, None], approximate=True)
@@ -186,6 +225,51 @@ GPT2_MOE_PARTITION_RULES = list(PARTITION_RULES) + [
     (r"mlp/wo", P("ep", None, None)),
     (r"mlp/bo", P("ep", None)),
 ]
+
+
+def moe_loss_summary(collection) -> Dict[str, jax.Array]:
+    """Aggregate a ``moe_losses`` sow collection (one entry per MoE block)
+    into scalars: mean ``aux_loss`` / ``router_z`` across layers, max
+    ``max_load`` across layers. Used by trainers to add the balance
+    penalty to the training loss and to surface routing health in stats."""
+    buckets: Dict[str, list] = {"aux_loss": [], "router_z": [], "max_load": []}
+
+    def walk(node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k in buckets:
+                    buckets[k].extend(v)  # sow stores a tuple per call
+                else:
+                    walk(v)
+
+    walk(collection)
+    if not buckets["aux_loss"]:
+        raise ValueError("no MoE losses were sown — is this an MoE model?")
+    return {
+        "aux_loss": jnp.mean(jnp.stack(buckets["aux_loss"])),
+        "router_z": jnp.mean(jnp.stack(buckets["router_z"])),
+        "max_load": jnp.max(jnp.stack(buckets["max_load"])),
+    }
+
+
+def apply_router_penalty(loss, stats, moe: Dict[str, jax.Array], cfg):
+    """Add the router load-balancing penalty to a training loss and surface
+    the routing health in the step stats — shared by every trainer that
+    trains an MoE family (PPO and ILQL use identical objectives here)."""
+    penalty = (
+        cfg.router_aux_coef * moe["aux_loss"]
+        + cfg.router_z_coef * moe["router_z"]
+    )
+    stats = dict(
+        stats,
+        **{
+            "losses/total_loss": stats["losses/total_loss"] + penalty,
+            "losses/moe_aux": moe["aux_loss"],
+            "losses/router_z": moe["router_z"],
+            "moe/max_load": moe["max_load"],
+        },
+    )
+    return loss + penalty, stats
 
 
 def _no_checkpoint(path: str, dtype: str = "float32"):

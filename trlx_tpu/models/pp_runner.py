@@ -11,8 +11,11 @@ at all — SURVEY §2.9 "PP: NO"; this is the beyond-parity axis).
 Scope and composition:
 - Stage s runs blocks ``[s*L/S, (s+1)*L/S)`` with an in-stage ``lax.scan``;
   activations hop stages via ``ppermute`` (GPipe schedule, differentiable).
-- Param *residency* follows the existing fsdp/tp partition rules — pp
-  shards compute, fsdp shards memory; the two compose on one mesh.
+- Param *residency* (at rest) follows the existing fsdp/tp partition
+  rules. During the pipeline loop itself, stage params are all-gathered
+  over fsdp at the shard_map boundary (`parallel/pipeline.py`): pp shards
+  params/compute *across stages*; fsdp shards the at-rest copy and the
+  optimizer state, not the running stage's working set.
 - Autoregressive decode keeps the standard GSPMD sampler (a KV cache
   threaded through pipeline stages is a different schedule; decode under a
   pp mesh runs the plain forward with params replicated over pp).

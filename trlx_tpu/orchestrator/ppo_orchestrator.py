@@ -55,6 +55,11 @@ class PPOOrchestrator(Orchestrator):
         super().__init__(trainer, pipeline)
         self.reward_fn = reward_fn
         self.chunk_size = chunk_size
+        # validate / bound the decode budget against the pipeline's real
+        # prompt lengths (raises on guaranteed zero-length responses;
+        # shrinks over-allocated max_new_tokens before anything compiles)
+        if hasattr(trainer, "bind_prompt_budget"):
+            trainer.bind_prompt_budget(pipeline)
         # chunk_size counts ROLLOUTS per chunk; a grouped trainer (GRPO, or
         # PPO with method.group_size > 1) turns each drawn prompt into
         # group_size rollouts, so the loader draws chunk_size / G prompts

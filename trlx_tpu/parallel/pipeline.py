@@ -125,6 +125,14 @@ def pipeline_apply(
 
     from jax import shard_map
 
+    # Stage params enter shard_map sharded over pp ONLY: each device holds
+    # its stage's L/S layers *fully materialized* for the loop's duration —
+    # any fsdp sharding on these params is all-gathered at this boundary.
+    # That is a deliberate memory/simplicity trade: keeping fsdp inside the
+    # loop would need a per-layer all_gather in the stage scan (gather one
+    # layer, compute, free) to avoid holding the gathered stage anyway.
+    # So pp here shards *compute and params across stages*; combine with
+    # fsdp to shard the *other* stages' memory, not the resident stage's.
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), stacked_params
     )

@@ -85,6 +85,18 @@ class PromptPipeline(BasePipeline):
                 else:
                     self.prompts_text[i] = " ".join(map(str, ids.tolist()))
         self.response_gt = list(response_gt) if response_gt is not None else None
+        # real (non-pad) token counts per prompt — trainers use these to
+        # validate/bound the decode budget against gen max_length without
+        # a device fetch (the mask is host numpy here)
+        self.prompt_lengths = self.attention_mask.sum(axis=1)
+
+    @property
+    def min_prompt_tokens(self) -> int:
+        return int(self.prompt_lengths.min()) if len(self) else 0
+
+    @property
+    def max_prompt_tokens(self) -> int:
+        return int(self.prompt_lengths.max()) if len(self) else 0
 
     def __len__(self) -> int:
         return len(self.input_ids)

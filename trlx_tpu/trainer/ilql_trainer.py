@@ -102,9 +102,14 @@ class ILQLTrainer(BaseRLTrainer):
             backbone_cls=self.family.backbone_cls,
         )
 
-        # sampling defaults live in ILQLConfig.gen_kwargs (config-visible);
-        # the tokenizer only fills missing eos/pad ids
-        gen_kwargs = dict(method.gen_kwargs or {})
+        # sampling defaults live in ILQLConfig.gen_kwargs (config-visible,
+        # merged by ILQLConfig.from_dict); re-merge here too so code that
+        # assigns config.method.gen_kwargs directly (examples do) still gets
+        # the reference's eval-decode defaults (top_k=20, ...) under its
+        # own keys rather than silently losing them
+        from trlx_tpu.ops.ilql_math import DEFAULT_ILQL_GEN_KWARGS
+
+        gen_kwargs = {**DEFAULT_ILQL_GEN_KWARGS, **(method.gen_kwargs or {})}
         self.apply_tokenizer_gen_defaults(gen_kwargs)
         self.gen_config = GenerationConfig.from_dict(gen_kwargs)
         validate_gen_config(
@@ -171,23 +176,46 @@ class ILQLTrainer(BaseRLTrainer):
             jnp.asarray(self.logit_mask) if self.logit_mask is not None else None
         )
 
+        moe_family = bool(getattr(self.family, "supports_ep", False))
+
         def train_step(state: ILQLTrainState, mb: ILQLBatch):
             def loss_fn(params):
-                out = self.model.apply(
-                    {"params": params},
-                    mb.input_ids,
-                    attention_mask=mb.attention_mask,
-                    actions_ixs=mb.actions_ixs,
-                    states_ixs=mb.states_ixs,
-                )
+                if moe_family:
+                    out, sown = self.model.apply(
+                        {"params": params},
+                        mb.input_ids,
+                        attention_mask=mb.attention_mask,
+                        actions_ixs=mb.actions_ixs,
+                        states_ixs=mb.states_ixs,
+                        mutable=["moe_losses"],
+                    )
+                else:
+                    out = self.model.apply(
+                        {"params": params},
+                        mb.input_ids,
+                        attention_mask=mb.attention_mask,
+                        actions_ixs=mb.actions_ixs,
+                        states_ixs=mb.states_ixs,
+                    )
                 target_qs = self.model.apply(
                     {"params": {"heads": state.target_q_params}},
                     out["action_hidden"],
                     method=CausalLMWithILQLHeads.target_qs,
                 )
-                return ilql_loss(
+                loss, stats = ilql_loss(
                     out["logits"], out["qs"], target_qs, out["vs"], mb, method
                 )
+                if moe_family:
+                    # same Switch load-balancing objective as the PPO path
+                    from trlx_tpu.models.gpt2_moe import (
+                        apply_router_penalty, moe_loss_summary,
+                    )
+
+                    loss, stats = apply_router_penalty(
+                        loss, stats, moe_loss_summary(sown["moe_losses"]),
+                        self.model_config,
+                    )
+                return loss, stats
 
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params

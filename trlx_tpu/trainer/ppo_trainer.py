@@ -188,6 +188,11 @@ class PPOTrainer(BaseRLTrainer):
         self.apply_tokenizer_gen_defaults(gen_kwargs)
         self._amend_gen_kwargs(gen_kwargs)
         self.gen_config = GenerationConfig.from_dict(gen_kwargs)
+        # decode-budget sizing state for bind_prompt_budget: the
+        # configured ceiling, and the min real prompt length of every
+        # pipeline bound so far (train + eval)
+        self._gen_budget_cap = self.gen_config.max_new_tokens
+        self._bound_min_prompts: Dict[str, int] = {}
         self.query_length = train.seq_length
         self._check_response_budget(train)
         validate_gen_config(
@@ -259,6 +264,10 @@ class PPOTrainer(BaseRLTrainer):
         self.mean_kl = 0.0
 
         self.setup_ep_axis(self.mesh, self.family)
+        # MoE families contribute router load-balancing losses to the
+        # training objective (collected via the "moe_losses" sow in
+        # _forward_logprobs_values)
+        self._moe_family = bool(getattr(self.family, "supports_ep", False))
         self._build_jitted_fns()
 
     # ----------------------- model-family hooks ----------------------- #
@@ -281,16 +290,72 @@ class PPOTrainer(BaseRLTrainer):
         """Every rollout must have >= 1 response token by construction: a
         zero-length response's terminal score lands on a masked slot and
         GAE (`ops/ppo_math.py` rewards*mask) silently zeroes it. For causal
-        LMs, gen max_length caps prompt + generated, so a prompt filling
-        the whole seq_length budget would emit an empty response."""
-        if 0 < self.gen_config.max_length <= train.seq_length:
-            raise ValueError(
-                f"gen_kwargs max_length={self.gen_config.max_length} must "
-                f"exceed train.seq_length={train.seq_length}: prompts at the "
-                "sequence budget would emit zero-length responses whose "
-                "terminal rewards PPO silently drops; raise max_length or "
-                "use max_new_tokens"
+        LMs, gen max_length caps prompt + generated — but whether a prompt
+        can fill that budget depends on *real* (non-pad) prompt lengths,
+        which only the pipeline knows (train.seq_length is just the padded
+        width; the reference's own `configs/ppo_config.yml` pairs
+        max_length 49 with seq_length 512 and is valid because its prompts
+        are short). The exact check runs in :meth:`bind_prompt_budget`
+        when the orchestrator attaches the training pipeline."""
+
+    def bind_prompt_budget(self, pipeline, role: str = "train") -> None:
+        """Validate + bound the decode budget against a bound pipeline's
+        real prompt lengths (causal: ``max_length`` caps prompt +
+        generated).
+
+        - ``role="train"``: raises when some prompt already fills
+          ``max_length`` — its rollout would have zero response tokens,
+          whose terminal score lands on a masked slot and GAE silently
+          drops it. For ``role="eval"`` the same situation only warns
+          (an empty eval generation is scored as an empty string, not
+          a corrupted update).
+        - Sizes ``max_new_tokens`` to the largest per-row budget over
+          *all* bound pipelines (``max_length`` − shortest real prompt
+          anywhere) when the config over-allocated (reference configs
+          write HF's ``max_length``; ``GenerationConfig.from_dict`` maps
+          it to the decode budget) — the compiled decode then scans
+          fewer steps and sizes a smaller KV cache, without capping a
+          later-bound short-prompt eval pipeline below its entitlement.
+          Rebuilds the jitted sampler on change.
+        """
+        max_len = self.gen_config.max_length
+        longest = getattr(pipeline, "max_prompt_tokens", None)
+        if max_len <= 0 or longest is None or not len(pipeline):
+            return
+        if longest >= max_len:
+            msg = (
+                f"a prompt with {longest} real tokens fills gen_kwargs "
+                f"max_length={max_len} (prompt + generated), leaving "
+                "zero response tokens; raise max_length, shorten the "
+                "prompts, or use max_new_tokens"
             )
+            if role == "train":
+                raise ValueError(
+                    msg + " (a zero-length rollout's terminal reward is "
+                    "silently dropped by PPO)"
+                )
+            import warnings
+
+            warnings.warn(msg + " (eval will score an empty string)")
+        # keyed by role so a *replaced* pipeline overrides (not
+        # min-accumulates) its predecessor's entitlement — the budget can
+        # re-shrink when a short-prompt eval pipeline is swapped out
+        self._bound_min_prompts[role] = int(pipeline.min_prompt_tokens)
+        budget = max_len - min(self._bound_min_prompts.values())
+        new = min(self._gen_budget_cap, budget) if budget > 0 else (
+            self._gen_budget_cap
+        )
+        if new != self.gen_config.max_new_tokens:
+            import dataclasses
+
+            self.gen_config = dataclasses.replace(
+                self.gen_config, max_new_tokens=new
+            )
+            self._rebuild_sampler()
+
+    def add_eval_pipeline(self, pipeline) -> None:
+        super().add_eval_pipeline(pipeline)
+        self.bind_prompt_budget(pipeline, role="eval")
 
     def _n_layers(self) -> int:
         from trlx_tpu.models.registry import num_layers_of
@@ -326,16 +391,20 @@ class PPOTrainer(BaseRLTrainer):
         )
 
     def _forward_logprobs_values(self, params, mb: PPORolloutBatch):
-        """Policy forward -> (logprobs, values, entropy?) over response
-        positions.
+        """Policy forward -> (logprobs, values, entropy?, moe_losses?) over
+        response positions.
 
         Causal LM: forward [query; response]; hidden states are sliced to
         positions Q-1..Q+R-2 (the states that *predict* each response token)
         *before* the LM/value heads run (``response_forward``). Per-position
-        entropy is computed only when the entropy bonus is on."""
+        entropy is computed only when the entropy bonus is on. For MoE
+        families the forward opens the ``moe_losses`` sow collection and
+        returns the aggregated router regularizers (Switch aux + z-loss +
+        load diagnostic) for the training loss."""
         Q = self.query_length
         full_ids = jnp.concatenate([mb.query_tokens, mb.response_tokens], axis=1)
         full_mask = jnp.concatenate([mb.query_mask, mb.response_mask], axis=1)
+        moe = None
         if self.pp_stages > 1:
             from trlx_tpu.models.pp_runner import pp_response_forward
 
@@ -343,6 +412,14 @@ class PPOTrainer(BaseRLTrainer):
                 self.model_config, params, full_ids, full_mask, Q,
                 self.mesh, self.pp_microbatches,
             )
+        elif self._moe_family:
+            from trlx_tpu.models.gpt2_moe import moe_loss_summary
+
+            (logits, values), state = self.model.apply(
+                {"params": params}, full_ids, full_mask, Q,
+                method=self.model.response_forward, mutable=["moe_losses"],
+            )
+            moe = moe_loss_summary(state["moe_losses"])
         else:
             logits, values = self.model.apply(
                 {"params": params}, full_ids, full_mask, Q,
@@ -352,7 +429,7 @@ class PPOTrainer(BaseRLTrainer):
         entropy = (
             _policy_entropy(logits) if self.config.method.ent_coef else None
         )
-        return logprobs, values.astype(jnp.float32), entropy
+        return logprobs, values.astype(jnp.float32), entropy, moe
 
     def _supports_hydra(self) -> bool:
         return True
@@ -434,16 +511,25 @@ class PPOTrainer(BaseRLTrainer):
             is_leaf=lambda x: isinstance(x, P),
         )
 
-    def _build_jitted_fns(self):
-        method: PPOConfig = self.config.method
+    def _rebuild_sampler(self):
+        """(Re)jit the rollout sampler from the current ``gen_config`` —
+        called at construction and again by :meth:`bind_prompt_budget`
+        when the decode budget shrinks (jit is lazy; no compile happens
+        until the first rollout, so a rebuild before training is free)."""
         batch_sh = batch_sharding(self.mesh)
         rep = replicated(self.mesh)
-
         self._sample_jit = jax.jit(
             self._make_sampler(),
             in_shardings=(self.param_shardings, batch_sh, batch_sh, rep),
             out_shardings=batch_sh,
         )
+
+    def _build_jitted_fns(self):
+        method: PPOConfig = self.config.method
+        batch_sh = batch_sharding(self.mesh)
+        rep = replicated(self.mesh)
+
+        self._rebuild_sampler()
 
         self._score_ref_jit = jax.jit(
             self._ref_logprobs,
@@ -466,11 +552,11 @@ class PPOTrainer(BaseRLTrainer):
 
         def train_step(state: TrainState, mb: PPORolloutBatch):
             def loss_fn(params):
-                logprobs, values, entropy = self._forward_logprobs_values(
+                logprobs, values, entropy, moe = self._forward_logprobs_values(
                     params, mb
                 )
                 advantages, returns = self._advantages_and_returns(mb)
-                return ppo_loss(
+                loss, stats = ppo_loss(
                     logprobs,
                     values,
                     mb.logprobs,
@@ -484,6 +570,16 @@ class PPOTrainer(BaseRLTrainer):
                     ent_coef=method.ent_coef,
                     entropy=entropy,
                 )
+                if moe is not None:
+                    # Switch load-balancing: without this, top-1 routing
+                    # collapses onto few experts once capacity drops are
+                    # real (anything below capacity_factor >= n_experts)
+                    from trlx_tpu.models.gpt2_moe import apply_router_penalty
+
+                    loss, stats = apply_router_penalty(
+                        loss, stats, moe, self.model_config
+                    )
+                return loss, stats
 
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params

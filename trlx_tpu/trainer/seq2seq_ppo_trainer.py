@@ -74,6 +74,11 @@ class Seq2SeqPPOTrainer(PPOTrainer):
                 "length response's terminal reward is silently dropped)"
             )
 
+    def bind_prompt_budget(self, pipeline, role: str = "train") -> None:
+        # encoder prompt lengths don't consume the decoder's max_length
+        # budget, so there is nothing to validate or shrink here
+        pass
+
     def _setup_model(self):
         from trlx_tpu.models.registry import get_model_family
 
@@ -141,7 +146,8 @@ class Seq2SeqPPOTrainer(PPOTrainer):
             if self.config.method.ent_coef
             else None
         )
-        return logprobs, out["values"].astype(jnp.float32), entropy
+        # no MoE T5 family: the 4th slot (router losses) is always None
+        return logprobs, out["values"].astype(jnp.float32), entropy, None
 
     def _supports_hydra(self) -> bool:
         # the fork disables the hydra branch for T5 and uses a full frozen
