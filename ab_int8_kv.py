@@ -1,0 +1,102 @@
+"""A/B: int8 vs bf16 rollout KV cache on the bench workload (real TPU).
+
+Methodology per the repo's measurement discipline: per measurement, queue
+K sampler dispatches on DISTINCT inputs (execution caching makes repeated
+identical calls free), force with ONE summed fetch (~110 ms flat), and
+interleave variants across rounds (wall-clock swings ±20% with machine
+load, so A/B by alternation, never against recorded numbers).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("WANDB_DISABLED", "1")
+
+import numpy as np
+
+
+def build_trainer(kv_dtype):
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "model_arch": {
+                    "vocab_size": 50257, "n_positions": 1024, "n_embd": 768,
+                    "n_layer": 12, "n_head": 12, "kv_cache_dtype": kv_dtype,
+                },
+            },
+            "train": {
+                "seq_length": 64, "batch_size": 16, "epochs": 1,
+                "total_steps": 10000, "eval_interval": 100000,
+                "checkpoint_interval": 1000000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1}, "dtype": "bfloat16",
+            },
+            "method": {
+                "name": "PPOConfig", "num_rollouts": 128, "chunk_size": 128,
+                "ppo_epochs": 4,
+                "gen_kwargs": {
+                    "max_new_tokens": 48, "min_new_tokens": 48, "top_k": 0,
+                    "do_sample": True, "eos_token_id": 50256,
+                    "pad_token_id": 50256,
+                },
+            },
+        }
+    )
+    return get_trainer(config.train.trainer)(
+        config, reward_fn=lambda **kw: [0.0]
+    )
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    B, Q, K = 128, 64, 10
+    rng = np.random.default_rng(0)
+
+    def fresh_batches(n):
+        return [
+            (
+                jnp.asarray(rng.integers(100, 40000, (B, Q)), jnp.int32),
+                jnp.ones((B, Q), jnp.int32),
+            )
+            for _ in range(n)
+        ]
+
+    trainers = {"bf16": build_trainer("bfloat16"), "int8": build_trainer("int8")}
+
+    def measure(trainer, batches):
+        t0 = time.time()
+        acc = jnp.zeros((), jnp.int32)
+        for ids, mask in batches:
+            out = trainer.sample(ids, mask)
+            acc = acc + out.tokens.sum()
+        _ = int(acc)  # single forcing fetch
+        return time.time() - t0
+
+    # warm both compiled samplers (distinct signatures)
+    for t in trainers.values():
+        measure(t, fresh_batches(1))
+
+    rounds = {"bf16": [], "int8": []}
+    for r in range(6):
+        for name in ("bf16", "int8") if r % 2 == 0 else ("int8", "bf16"):
+            rounds[name].append(measure(trainers[name], fresh_batches(K)))
+    for name, ts in rounds.items():
+        per_call = [(t - 0.11) / K for t in ts]
+        print(
+            f"{name}: per-sampler-call mean {np.mean(per_call)*1e3:.1f} ms  "
+            f"median {np.median(per_call)*1e3:.1f} ms  "
+            f"all {[round(x*1e3, 1) for x in per_call]}"
+        )
+    speedup = np.median(rounds["bf16"]) / np.median(rounds["int8"])
+    print(f"int8 speedup over bf16 (median-of-rounds): {speedup:.3f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,52 @@ def _phase_flops(d, V, L, Q, R, B, ppo_epochs):
     train = ppo_epochs * B * 3 * fwd(T, T * (T + 1) // 2, R)
     return collect, train
 
+def _reward_tier():
+    """The BASELINE metric's other half: mean reward, measured — PPO-steer
+    the locally-pretrained two-topic stand-in checkpoint (the offline tier
+    of the reference's gpt2-imdb + distilbert sentiment workload,
+    `examples/ppo_sentiments.py:23-54`) for a fixed 96-update budget and
+    report the full-eval mean reward before and after. The checkpoint is
+    cached under ``ckpts/``; reward is in [-1, 1] (response-token
+    sentiment), starting near 0 on balanced prompts."""
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "examples"))
+    try:
+        import trlx_tpu
+        from trlx_tpu.data.configs import TRLConfig
+        from pretrained_standin import (
+            causal_rl_config, ensure_gpt2_checkpoint, make_prompts,
+            sentiment_reward,
+        )
+
+        ckpt_dir = ensure_gpt2_checkpoint()
+        prompts = make_prompts(np.random.default_rng(1), 256, 8)
+        means = []
+
+        def reward_fn(samples, queries, response_gt=None):
+            scores = sentiment_reward(samples, queries, response_gt)
+            means.append(float(np.mean(scores)))
+            return scores
+
+        t0 = time.time()
+        trlx_tpu.train(
+            reward_fn=reward_fn,
+            prompts=prompts,
+            config=TRLConfig.from_dict(causal_rl_config(ckpt_dir)),
+        )
+        # learn() evaluates at step 0 and at the end: first/last entries
+        # are full-eval means; the interior is the rollout-phase curve
+        return {
+            "mean_reward_pre": round(means[0], 4),
+            "mean_reward_post": round(means[-1], 4),
+            "reward_tier_seconds": round(time.time() - t0, 1),
+        }
+    except Exception as e:  # the throughput number must still print
+        return {"mean_reward_error": f"{type(e).__name__}: {e}"}
+
+
 def main():
     import numpy as np
 
@@ -84,6 +130,10 @@ def main():
                     "n_embd": 768,
                     "n_layer": 12,
                     "n_head": 12,
+                    # int8 rollout KV cache: measured 1.10x on the sampler
+                    # (interleaved A/B, ab_int8_kv.py) — decode is
+                    # HBM-bound and the cache is its dominant traffic
+                    "kv_cache_dtype": "int8",
                 },
             },
             "train": {
@@ -200,6 +250,14 @@ def main():
         extras["train_phase_mfu"] = round(
             n_phases * train_flops / times["train"] / n_chips / 1e12 / peak, 4
         )
+        # the weakest phase gets its own falsifiable number (VERDICT r2):
+        # collect = compiled sampler + frozen-ref forward + host reward
+        extras["collect_phase_mfu"] = round(
+            n_phases * collect_flops / times["collect"] / n_chips / 1e12 / peak,
+            4,
+        )
+
+    extras.update(_reward_tier())
 
     print(
         json.dumps(

@@ -360,3 +360,63 @@ def test_filter_logits_temperature_and_top_k():
     np.testing.assert_allclose(out, [2.0, 1.5, 1.0, 0.5])
     out = np.asarray(filter_logits(logits, GenerationConfig(top_k=2)))[0]
     assert np.isfinite(out[:2]).all() and (out[2:] < -1e8).all()
+
+
+def test_int8_kv_cache_matches_bf16_closely(tiny_policy):
+    """The int8 rollout cache (absmax-per-token/head quantization,
+    `models/gpt2.py::quantize_kv`) must produce decode logprobs close to
+    the exact cache: same sampler, same rng, cache dtype the only delta.
+    Quantization noise bounds the drift; the importance ratios in the PPO
+    update absorb this (behavior logprobs stay self-consistent either
+    way)."""
+    import dataclasses
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.gpt2 import init_cache
+    from trlx_tpu.ops.sampling import GenerationConfig, make_sampler
+
+    config, model, params = tiny_policy
+    q_config = dataclasses.replace(config, kv_cache_dtype="int8")
+    Q, R, B = 6, 5, 4
+    rng = np.random.default_rng(3)
+    ids = np.zeros((B, Q), np.int32)
+    mask = np.zeros((B, Q), np.int32)
+    for i, L in enumerate([6, 5, 3, 2]):
+        ids[i, Q - L :] = rng.integers(1, 96, size=L)
+        mask[i, Q - L :] = 1
+
+    def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
+                 cache=None, cache_index=None):
+        return model.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            position_ids=position_ids, cache=cache, cache_index=cache_index,
+        )
+
+    gen = GenerationConfig(
+        max_new_tokens=R, do_sample=False, eos_token_id=96, pad_token_id=0,
+        top_k=0,
+    )
+    outs = {}
+    for name, cfg in [("bf16", config), ("int8", q_config)]:
+        sampler = make_sampler(
+            apply_fn, functools.partial(init_cache, cfg), gen, Q
+        )
+        outs[name] = sampler(
+            params, jnp.asarray(ids), jnp.asarray(mask), jax.random.PRNGKey(1)
+        )
+    # int8 cache buffers really are int8
+    cache = init_cache(q_config, B, Q + R)
+    assert cache[0]["k"].dtype == jnp.int8 and "k_scale" in cache[0]
+    # greedy tokens agree and behavior logprobs drift only by quantization
+    np.testing.assert_array_equal(
+        np.asarray(outs["bf16"].tokens), np.asarray(outs["int8"].tokens)
+    )
+    m = np.asarray(outs["bf16"].response_mask).astype(bool)
+    np.testing.assert_allclose(
+        np.asarray(outs["bf16"].logprobs)[m],
+        np.asarray(outs["int8"].logprobs)[m],
+        atol=0.05,
+    )

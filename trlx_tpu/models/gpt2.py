@@ -46,6 +46,21 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     dtype: str = "bfloat16"  # compute dtype (MXU path)
     param_dtype: str = "float32"
+    # Rollout KV-cache storage. Single-token decode is HBM-bound and the
+    # cache is its dominant traffic (grows with context while weights
+    # stay fixed), so "int8" halves the bottleneck: K/V quantized per
+    # (token, head) on write (absmax/127 scale), dequantized on read
+    # inside the attention matmul's operand fusion. Training/scoring
+    # forwards never touch this — only the sampler's cache buffers.
+    kv_cache_dtype: str = "bfloat16"  # "bfloat16" | "int8"
+
+    def __post_init__(self):
+        if self.kv_cache_dtype not in ("bfloat16", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={self.kv_cache_dtype!r} is not supported "
+                "(choose 'bfloat16' or 'int8') — an unrecognized value "
+                "would otherwise silently fall back to bf16 buffers"
+            )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "GPT2Config":
@@ -107,9 +122,34 @@ class Attention(nn.Module):
             # Write this step's keys/values into the capacity buffer at
             # cache_index, then attend over the whole buffer (invalid
             # positions are masked by `bias`).
-            k = jax.lax.dynamic_update_slice(cache_kv["k"], k, (0, cache_index, 0, 0))
-            v = jax.lax.dynamic_update_slice(cache_kv["v"], v, (0, cache_index, 0, 0))
-            new_kv = {"k": k, "v": v}
+            if "k_scale" in cache_kv:
+                # int8 cache: quantize the new slice, store value+scale,
+                # dequantize the whole buffer for attention — the
+                # convert+mul folds into the attention matmuls' operand
+                # read, so HBM sees int8, the MXU sees bf16
+                k_q, k_s = quantize_kv(k)
+                v_q, v_s = quantize_kv(v)
+                at = (0, cache_index, 0, 0)
+                new_kv = {
+                    "k": jax.lax.dynamic_update_slice(cache_kv["k"], k_q, at),
+                    "v": jax.lax.dynamic_update_slice(cache_kv["v"], v_q, at),
+                    "k_scale": jax.lax.dynamic_update_slice(
+                        cache_kv["k_scale"], k_s, at
+                    ),
+                    "v_scale": jax.lax.dynamic_update_slice(
+                        cache_kv["v_scale"], v_s, at
+                    ),
+                }
+                k = new_kv["k"].astype(dtype) * new_kv["k_scale"].astype(dtype)
+                v = new_kv["v"].astype(dtype) * new_kv["v_scale"].astype(dtype)
+            else:
+                k = jax.lax.dynamic_update_slice(
+                    cache_kv["k"], k, (0, cache_index, 0, 0)
+                )
+                v = jax.lax.dynamic_update_slice(
+                    cache_kv["v"], v, (0, cache_index, 0, 0)
+                )
+                new_kv = {"k": k, "v": v}
 
         out = dot_product_attention(q, k, v, bias, causal=causal)
         out = out.reshape(B, T, cfg.n_embd)
@@ -230,11 +270,33 @@ class GPT2Model(nn.Module):
         return out
 
 
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization over the head dim: per (batch, token,
+    head) absmax/127 scale. Returns (int8 values, scale[..., :1])."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(scale, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.bfloat16)
+
+
 def init_cache(config: GPT2Config, batch_size: int, capacity: int) -> Cache:
-    """Fixed-capacity KV buffers (one compile for the whole decode loop)."""
+    """Fixed-capacity KV buffers (one compile for the whole decode loop).
+    With ``kv_cache_dtype="int8"``, buffers store int8 values + per
+    (token, head) bf16 scales — ~half the HBM traffic of a bf16 cache."""
     head_dim = config.n_embd // config.n_head
     shape = (batch_size, capacity, config.n_head, head_dim)
     dtype = jnp.dtype(config.dtype)
+    if getattr(config, "kv_cache_dtype", "bfloat16") == "int8":
+        sshape = (batch_size, capacity, config.n_head, 1)
+        return tuple(
+            {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.bfloat16),
+                "v_scale": jnp.zeros(sshape, jnp.bfloat16),
+            }
+            for _ in range(config.n_layer)
+        )
     return tuple(
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(config.n_layer)
