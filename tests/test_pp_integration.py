@@ -185,3 +185,63 @@ def test_pp_rejects_hydra_and_non_gpt2():
     }
     with pytest.raises(NotImplementedError, match="GPT-2"):
         get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+
+
+def test_pp_decode_matches_plain_sampler():
+    """Round-3: rollout decode under pp runs the pipelined cached forward
+    with stage-resident KV buffers (`pp_runner.pp_cached_hidden`) instead
+    of a full replicated model per pp device. Same seed/params/rng as a
+    plain-mesh trainer => identical tokens, logprob/value parity."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    t_pp = get_trainer("PPOTrainer")(
+        _config({"dp": 2, "fsdp": 2, "tp": 1, "pp": 2}),
+        reward_fn=lambda **kw: [0.0],
+    )
+    t_pl = get_trainer("PPOTrainer")(
+        _config({"dp": -1, "fsdp": 1, "tp": 1}),
+        reward_fn=lambda **kw: [0.0],
+    )
+    # same config.train.seed => identical init params on both meshes
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(t_pp.state.params)),
+        jax.tree_util.tree_leaves(jax.device_get(t_pl.state.params)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    rng = np.random.default_rng(0)
+    B, Q = 16, 4
+    lens = rng.integers(1, Q + 1, size=B)
+    ids = np.zeros((B, Q), np.int32)
+    mask = np.zeros((B, Q), np.int32)
+    for i, L in enumerate(lens):
+        ids[i, Q - L :] = rng.integers(1, 13, size=L)
+        mask[i, Q - L :] = 1
+    ids, mask = jnp.asarray(ids), jnp.asarray(mask)
+
+    out_pp = t_pp.sample(ids, mask)
+    out_pl = t_pl.sample(ids, mask)
+    np.testing.assert_array_equal(
+        np.asarray(out_pp.tokens), np.asarray(out_pl.tokens)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_pp.response_mask), np.asarray(out_pl.response_mask)
+    )
+    m = np.asarray(out_pl.response_mask).astype(bool)
+    np.testing.assert_allclose(
+        np.asarray(out_pp.logprobs)[m], np.asarray(out_pl.logprobs)[m],
+        atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_pp.values)[m], np.asarray(out_pl.values)[m], atol=1e-4
+    )
+    # the pp cache really shards layers over the pp axis: peek via the
+    # trainer's compiled sampler cache spec (init path)
+    from trlx_tpu.models.pp_runner import pp_init_cache
+
+    cache = pp_init_cache(t_pp.model_config, B, Q + 6)
+    assert cache["k"].shape[0] == t_pp.model_config.n_layer

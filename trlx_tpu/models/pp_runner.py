@@ -16,9 +16,11 @@ Scope and composition:
   over fsdp at the shard_map boundary (`parallel/pipeline.py`): pp shards
   params/compute *across stages*; fsdp shards the at-rest copy and the
   optimizer state, not the running stage's working set.
-- Autoregressive decode keeps the standard GSPMD sampler (a KV cache
-  threaded through pipeline stages is a different schedule; decode under a
-  pp mesh runs the plain forward with params replicated over pp).
+- Autoregressive decode (round 3) runs the SAME pipeline schedule with
+  stage-resident KV caches: the sampler's cache is layer-major
+  ``[L, B, C, H, Dh]`` sharded over pp, so each device holds only its
+  stage's layers and cache during rollouts (``pp_cached_hidden`` /
+  ``make_pp_sampler_apply`` below) — no replicated full-model copy.
 """
 
 from __future__ import annotations
@@ -147,3 +149,149 @@ def pp_ref_logits(
         mesh, num_microbatches,
     )
     return _logits(config, backbone_params, h[:, query_length - 1 : -1])
+
+
+# --------------------------- pp rollout decode --------------------------- #
+#
+# Round 3: decode under a pp mesh no longer replicates the full model per
+# device. The sampler's KV cache becomes layer-major [L, B, C, H, Dh]
+# sharded P(pp, (dp, fsdp)) — each device holds the cache AND compute of
+# its own stage's L/S layers only — and every sampler forward (prefill +
+# each decode token) runs the GPipe schedule with the cache resident in
+# the stages (`parallel/pipeline.py::pipeline_apply_cached`). Embedding,
+# ln_f, LM head, and the value head stay replicated over pp (they are a
+# small fraction of weights and need the full batch anyway).
+
+
+def pp_init_cache(config: GPT2Config, batch_size: int, capacity: int):
+    """Layer-major KV buffers for pp decode: ``{"k","v"}: [L, B, C, H, Dh]``
+    (vs the GSPMD sampler's per-layer tuple). bf16 storage; the int8
+    rollout-cache option does not yet compose with pp."""
+    head_dim = config.n_embd // config.n_head
+    shape = (config.n_layer, batch_size, capacity, config.n_head, head_dim)
+    dtype = jnp.dtype(config.dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def pp_stack_sampler_params(config: GPT2Config, mesh: Mesh, params):
+    """Pre-stack the trunk blocks for the pp sampler, ONCE per sampler
+    invocation (outside the decode scan): the jnp.stack of every layer and
+    the regather to P('pp') residency are loop-invariant, and leaving them
+    inside the per-token apply would rely on XLA hoisting them out of the
+    while-loop body (round-3 review). Returns the packed params pytree the
+    ``make_pp_sampler_apply`` closure expects."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    S = mesh.shape["pp"]
+    stacked = _stack_stages(
+        [params["transformer"][f"h_{i}"] for i in range(config.n_layer)], S
+    )
+    stacked = jax.tree_util.tree_map(
+        lambda p: jax.lax.with_sharding_constraint(
+            p, NamedSharding(mesh, PartitionSpec("pp"))
+        ),
+        stacked,
+    )
+    return {
+        "transformer": params["transformer"],
+        "v_head": params["v_head"],
+        "stacked_blocks": stacked,
+    }
+
+
+def pp_cached_hidden(
+    config: GPT2Config,
+    backbone_params,
+    input_ids: jax.Array,  # [B, T]
+    attention_mask: jax.Array,  # [B, C] cache-validity mask
+    position_ids: jax.Array,  # [B, T]
+    cache,  # pp_init_cache layout
+    cache_index,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    stacked=None,  # pre-stacked blocks (pp_stack_sampler_params)
+):
+    """(hidden after ln_f, new cache) for a cached forward (prefill T=Q or
+    decode T=1) with blocks pipelined over pp and stage-resident caches."""
+    from trlx_tpu.ops.attention import causal_bias, combine_biases, padding_bias
+    from trlx_tpu.parallel.pipeline import pipeline_apply_cached
+
+    S = mesh.shape["pp"]
+    if config.n_layer % S:
+        raise ValueError(f"n_layer={config.n_layer} must divide pp={S}")
+    backbone = GPT2Model(config)
+    x = backbone.apply(
+        {"params": backbone_params}, input_ids, position_ids,
+        method=GPT2Model.embed,
+    )
+    T = input_ids.shape[1]
+    C = cache["k"].shape[2]
+    B = input_ids.shape[0]
+    # explicit per-row bias (aux rides microbatch slicing, so batch-lead it)
+    bias = combine_biases(
+        causal_bias(T, C, offset=cache_index), padding_bias(attention_mask)
+    )
+    bias = jnp.broadcast_to(bias, (B,) + bias.shape[1:])
+
+    if stacked is None:
+        stacked = _stack_stages(
+            [backbone_params[f"h_{i}"] for i in range(config.n_layer)], S
+        )
+    block = Block(config)
+
+    def stage_fn(stage_params, h, bias_mb, stage_cache_mb, idx):
+        # stage_cache_mb leaves [L/S, bm, C, H, Dh]: scan layers, thread h
+        def body(h, xs):
+            p, kv = xs
+            h, new_kv = block.apply(
+                {"params": p}, h, bias_mb, cache_kv=kv, cache_index=idx,
+                causal=False,
+            )
+            return h, new_kv
+
+        h, new_kvs = jax.lax.scan(body, h, (stage_params, stage_cache_mb))
+        return h, new_kvs
+
+    h, new_cache = pipeline_apply_cached(
+        stage_fn, stacked, x, cache, cache_index, mesh,
+        num_microbatches=num_microbatches, aux=bias,
+    )
+    h = backbone.apply(
+        {"params": backbone_params}, h, method=lambda m, v: m.ln_f(v)
+    )
+    return h, new_cache
+
+
+def make_pp_sampler_apply(
+    config: GPT2Config,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+):
+    """Sampler ``apply_fn`` for a pp mesh: matches the contract of
+    ``CausalLMWithValueHead`` applies in `trainer/ppo_trainer.py` —
+    ``(params, input_ids, attention_mask, position_ids, cache,
+    cache_index, last_only) -> {"logits", "values", "cache"}`` — with the
+    trunk pipelined and the cache stage-resident. ``params`` is the PACKED
+    tree from :func:`pp_stack_sampler_params` (blocks pre-stacked once per
+    sampler invocation, not once per decoded token). Logits/values are
+    computed at the LAST position only (shape [B, 1, ...]), which is all
+    the sampler reads for both prefill and decode."""
+    from trlx_tpu.models.heads import MLPHead
+
+    v_head = MLPHead(
+        config.n_embd, 1, dtype=config.dtype, param_dtype=config.param_dtype
+    )
+
+    def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
+                 cache=None, cache_index=None, last_only=False):
+        h, new_cache = pp_cached_hidden(
+            config, params["transformer"], input_ids, attention_mask,
+            position_ids, cache, cache_index, mesh, num_microbatches,
+            stacked=params["stacked_blocks"],
+        )
+        hs = h[:, -1:]
+        logits = _logits(config, params["transformer"], hs)
+        values = v_head.apply({"params": params["v_head"]}, hs)[..., 0]
+        return {"logits": logits, "values": values, "cache": new_cache}
+
+    return apply_fn

@@ -57,6 +57,53 @@ def pipeline_apply(
     third argument: ``stage_fn(params, h, aux_mb)``. Unlike ``h``, aux does
     not travel over the wire (every device holds its batch shard).
     """
+    # One schedule implementation: the cache-less path is the cached path
+    # with an empty cache pytree (round-3 review: two hand-synced copies of
+    # the GPipe tick invite silent divergence).
+    if aux is None:
+        def adapted(p, h, _aux, _cache, _idx):
+            return stage_fn(p, h), {}
+    else:
+        def adapted(p, h, aux_m, _cache, _idx):
+            return stage_fn(p, h, aux_m), {}
+
+    out, _ = pipeline_apply_cached(
+        adapted, stacked_params, x, {}, 0, mesh,
+        axis_name=axis_name, num_microbatches=num_microbatches,
+        batch_axes=batch_axes, aux=aux,
+    )
+    return out
+
+
+def pipeline_apply_cached(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,  # [B, T, ...] activations entering stage 0
+    cache,  # leaves [L, B, C, ...]: layer-major KV buffers, L sharded over pp
+    cache_index,
+    mesh: Mesh,
+    axis_name: str = "pp",
+    num_microbatches: int = 2,
+    batch_axes=("dp", "fsdp"),
+    aux=None,
+):
+    """GPipe schedule with STAGE-RESIDENT KV caches: the rollout-decode
+    counterpart of :func:`pipeline_apply`.
+
+    ``cache`` leaves are layer-major ``[L, B, C, ...]`` sharded ``P(pp,
+    batch_axes)`` — each device permanently holds the KV buffers of its own
+    stage's ``L/S`` layers (plus its dp/fsdp batch shard), so a pp mesh
+    shards rollout *memory and compute* instead of replicating the full
+    model per device (the pre-round-3 behavior). Each tick, the active
+    stage reads/writes only the microbatch rows it is processing; writes at
+    inactive (bubble) ticks are masked back to the old values.
+
+    ``stage_fn(stage_params, h, aux_mb, stage_cache_mb, cache_index) ->
+    (h, new_stage_cache_mb)`` where ``stage_cache_mb`` leaves are
+    ``[L/S, b_mb, C, ...]``.
+
+    Returns ``(out, new_cache)`` with the same shardings as ``(x, cache)``.
+    """
     S = mesh.shape[axis_name]
     M = num_microbatches
     for leaf in jax.tree_util.tree_leaves(stacked_params):
@@ -66,6 +113,11 @@ def pipeline_apply(
                 f"the {axis_name!r} axis has {S} devices (one stage per "
                 f"device); extra stages would be silently dropped"
             )
+    for leaf in jax.tree_util.tree_leaves(cache):
+        if leaf.shape[0] % S:
+            raise ValueError(
+                f"cache layer dim {leaf.shape[0]} must divide pp={S}"
+            )
     n_batch_shards = int(np.prod([mesh.shape[a] for a in batch_axes]))
     B_local = x.shape[0] // n_batch_shards
     if x.shape[0] % n_batch_shards or B_local % M:
@@ -74,54 +126,60 @@ def pipeline_apply(
             f"{M} microbatches"
         )
 
-    def local(params, x, aux):
-        # params leaves arrive as [1, ...] (this device's stage); x is this
-        # device's batch shard, replicated over the pp axis.
+    def local(params, x, cache, cache_index, aux):
         params = jax.tree_util.tree_map(lambda p: p[0], params)
         idx = jax.lax.axis_index(axis_name)
         n = jax.lax.psum(1, axis_name)
         b = x.shape[0]
-        mbs = x.reshape((M, b // M) + x.shape[1:]).astype(x.dtype)
+        bm = b // M
+        mbs = x.reshape((M, bm) + x.shape[1:]).astype(x.dtype)
         aux_mbs = jax.tree_util.tree_map(
             lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:]), aux
         )
 
         perm = [(i, (i + 1) % n) for i in range(n)]
-        # carries must be pp-varying from the start (shard_map vma typing):
-        # derive a pp-varying zero from axis_index
         pp_zero = (0.0 * jax.lax.axis_index(axis_name)).astype(x.dtype)
         buf0 = jnp.zeros_like(mbs[0]) + pp_zero
         outs0 = jnp.zeros_like(mbs) + pp_zero
 
         def tick(t, carry):
-            buf, outs = carry
-            m = t - idx  # microbatch this stage works on at tick t
+            buf, outs, cache = carry
+            m = t - idx
             active = jnp.logical_and(m >= 0, m < M)
             m_c = jnp.clip(m, 0, M - 1)
-            # stage 0 pulls from the microbatch stream; others from the wire
             h_in = jnp.where(idx == 0, mbs[m_c], buf)
-            if aux is None:
-                h_out = stage_fn(params, h_in)
-            else:
-                aux_m = jax.tree_util.tree_map(lambda a: a[m_c], aux_mbs)
-                h_out = stage_fn(params, h_in, aux_m)
-            # collect finished microbatches on the last stage
+            aux_m = jax.tree_util.tree_map(lambda a: a[m_c], aux_mbs)
+            old_mb = jax.tree_util.tree_map(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, m_c * bm, bm, axis=1),
+                cache,
+            )
+            h_out, new_mb = stage_fn(params, h_in, aux_m, old_mb, cache_index)
+            # bubble ticks compute on garbage: mask their cache writes
+            new_mb = jax.tree_util.tree_map(
+                lambda nk, ok: jnp.where(active, nk.astype(ok.dtype), ok),
+                new_mb, old_mb,
+            )
+            cache = jax.tree_util.tree_map(
+                lambda c, nk: jax.lax.dynamic_update_slice_in_dim(
+                    c, nk, m_c * bm, axis=1
+                ),
+                cache, new_mb,
+            )
             outs = jnp.where(
                 jnp.logical_and(idx == n - 1, active),
                 outs.at[m_c].set(h_out),
                 outs,
             )
-            # hand the activation to the next stage (masked when idle so
-            # garbage never overwrites a live microbatch downstream)
             wire = jnp.where(active, h_out, buf * 0.0)
             buf = jax.lax.ppermute(wire, axis_name, perm)
-            return buf, outs
+            return buf, outs, cache
 
-        _, outs = jax.lax.fori_loop(0, S + M - 1, tick, (buf0, outs0))
-        # only the last stage holds real outputs; broadcast over the pp axis
+        _, outs, cache = jax.lax.fori_loop(
+            0, S + M - 1, tick, (buf0, outs0, cache)
+        )
         outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
         outs = jax.lax.psum(outs, axis_name)
-        return outs.reshape(x.shape)
+        return outs.reshape(x.shape), cache
 
     from jax import shard_map
 
@@ -137,10 +195,13 @@ def pipeline_apply(
         lambda _: P(axis_name), stacked_params
     )
     x_spec = P(batch_axes)
+    cache_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name, batch_axes), cache
+    )
     aux_specs = jax.tree_util.tree_map(lambda _: P(batch_axes), aux)
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs, x_spec, aux_specs),
-        out_specs=x_spec,
-    )(stacked_params, x, aux)
+        in_specs=(param_specs, x_spec, cache_specs, P(), aux_specs),
+        out_specs=(x_spec, cache_specs),
+    )(stacked_params, x, cache, cache_index, aux)

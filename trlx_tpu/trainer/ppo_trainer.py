@@ -367,7 +367,49 @@ class PPOTrainer(BaseRLTrainer):
         return self.model.init(rng, dummy)["params"]
 
     def _make_sampler(self) -> Callable:
-        """Jittable (params, prompt_ids, prompt_mask, rng) -> SampleOutput."""
+        """Jittable (params, prompt_ids, prompt_mask, rng) -> SampleOutput.
+
+        Under a pp mesh the rollout runs the pipelined cached forward with
+        STAGE-RESIDENT KV buffers (`models/pp_runner.py`): each pp device
+        holds only its stage's layers + cache during the dominant phase,
+        instead of a full replicated copy."""
+        if self.pp_stages > 1:
+            from trlx_tpu.models.pp_runner import (
+                make_pp_sampler_apply,
+                pp_init_cache,
+                pp_stack_sampler_params,
+            )
+            from trlx_tpu.parallel.mesh import BATCH_AXES
+
+            if getattr(self.model_config, "kv_cache_dtype", "bfloat16") != (
+                "bfloat16"
+            ):
+                raise NotImplementedError(
+                    f"kv_cache_dtype={self.model_config.kv_cache_dtype!r} "
+                    "does not compose with a pp mesh yet: the pp sampler's "
+                    "stage-resident cache stores bf16; drop the flag or "
+                    "the pp axis"
+                )
+            inner = make_sampler(
+                make_pp_sampler_apply(
+                    self.model_config, self.mesh, self.pp_microbatches
+                ),
+                functools.partial(pp_init_cache, self.model_config),
+                self.gen_config,
+                self.query_length,
+                with_values=True,
+                cache_sharding=NamedSharding(self.mesh, P("pp", BATCH_AXES)),
+            )
+
+            def sampler(params, prompt_ids, prompt_mask, rng):
+                # stack/reshard the trunk blocks ONCE per invocation, not
+                # once per decoded token inside the sampler's scan
+                packed = pp_stack_sampler_params(
+                    self.model_config, self.mesh, params
+                )
+                return inner(packed, prompt_ids, prompt_mask, rng)
+
+            return sampler
 
         def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
                      cache=None, cache_index=None, last_only=False):
