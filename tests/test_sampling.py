@@ -420,3 +420,36 @@ def test_int8_kv_cache_matches_bf16_closely(tiny_policy):
         np.asarray(outs["int8"].logprobs)[m],
         atol=0.05,
     )
+
+
+def test_int8_cache_extends_to_all_causal_families():
+    """`kv_cache_dtype="int8"` plumbs through every causal family's cache
+    initializer (the write path is shared: `models/gpt2.py::write_cache`);
+    unknown values fail loudly."""
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from trlx_tpu.models.gpt_neo import GPTNeoConfig, init_gpt_neo_cache
+    from trlx_tpu.models.gptj import GPTJConfig, init_gptj_cache
+    from trlx_tpu.models.neox import NeoXConfig, init_neox_cache
+
+    cases = [
+        (init_gptj_cache, GPTJConfig(
+            vocab_size=32, n_positions=16, n_embd=32, n_layer=2, n_head=2,
+            rotary_dim=8, kv_cache_dtype="int8")),
+        (init_gpt_neo_cache, GPTNeoConfig(
+            vocab_size=32, max_position_embeddings=16, hidden_size=32,
+            num_layers=2, num_heads=2, kv_cache_dtype="int8")),
+        (init_neox_cache, NeoXConfig(
+            vocab_size=32, max_position_embeddings=16, hidden_size=32,
+            num_hidden_layers=2, num_attention_heads=2,
+            kv_cache_dtype="int8")),
+    ]
+    for init, cfg in cases:
+        cache = init(cfg, 4, 8)
+        assert cache[0]["k"].dtype == jnp.int8, type(cfg).__name__
+        assert cache[0]["k_scale"].shape == (4, 8, 2, 1), type(cfg).__name__
+    from dataclasses import replace
+
+    with _pytest.raises(ValueError, match="kv_cache_dtype"):
+        init_gptj_cache(replace(cases[0][1], kv_cache_dtype="fp8"), 4, 8)

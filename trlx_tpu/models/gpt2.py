@@ -122,34 +122,7 @@ class Attention(nn.Module):
             # Write this step's keys/values into the capacity buffer at
             # cache_index, then attend over the whole buffer (invalid
             # positions are masked by `bias`).
-            if "k_scale" in cache_kv:
-                # int8 cache: quantize the new slice, store value+scale,
-                # dequantize the whole buffer for attention — the
-                # convert+mul folds into the attention matmuls' operand
-                # read, so HBM sees int8, the MXU sees bf16
-                k_q, k_s = quantize_kv(k)
-                v_q, v_s = quantize_kv(v)
-                at = (0, cache_index, 0, 0)
-                new_kv = {
-                    "k": jax.lax.dynamic_update_slice(cache_kv["k"], k_q, at),
-                    "v": jax.lax.dynamic_update_slice(cache_kv["v"], v_q, at),
-                    "k_scale": jax.lax.dynamic_update_slice(
-                        cache_kv["k_scale"], k_s, at
-                    ),
-                    "v_scale": jax.lax.dynamic_update_slice(
-                        cache_kv["v_scale"], v_s, at
-                    ),
-                }
-                k = new_kv["k"].astype(dtype) * new_kv["k_scale"].astype(dtype)
-                v = new_kv["v"].astype(dtype) * new_kv["v_scale"].astype(dtype)
-            else:
-                k = jax.lax.dynamic_update_slice(
-                    cache_kv["k"], k, (0, cache_index, 0, 0)
-                )
-                v = jax.lax.dynamic_update_slice(
-                    cache_kv["v"], v, (0, cache_index, 0, 0)
-                )
-                new_kv = {"k": k, "v": v}
+            k, v, new_kv = write_cache(cache_kv, k, v, cache_index, dtype)
 
         out = dot_product_attention(q, k, v, bias, causal=causal)
         out = out.reshape(B, T, cfg.n_embd)
@@ -279,15 +252,55 @@ def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return q.astype(jnp.int8), scale.astype(jnp.bfloat16)
 
 
-def init_cache(config: GPT2Config, batch_size: int, capacity: int) -> Cache:
-    """Fixed-capacity KV buffers (one compile for the whole decode loop).
-    With ``kv_cache_dtype="int8"``, buffers store int8 values + per
-    (token, head) bf16 scales — ~half the HBM traffic of a bf16 cache."""
-    head_dim = config.n_embd // config.n_head
-    shape = (batch_size, capacity, config.n_head, head_dim)
-    dtype = jnp.dtype(config.dtype)
-    if getattr(config, "kv_cache_dtype", "bfloat16") == "int8":
-        sshape = (batch_size, capacity, config.n_head, 1)
+def write_cache(cache_kv, k, v, cache_index, dtype):
+    """Write this step's K/V into the capacity buffers at ``cache_index``;
+    returns ``(k, v, new_kv)`` — the full buffers to attend over and the
+    updated cache dict. Transparent over the two storage layouts (shared
+    by every causal family):
+
+    - plain: ``{"k", "v"}`` in the compute dtype;
+    - int8 (``kv_cache_dtype="int8"``): quantize the new slice, store
+      value+scale, dequantize the whole buffer for attention — the
+      convert+mul folds into the attention matmuls' operand read, so HBM
+      sees int8, the MXU sees bf16.
+    """
+    at = (0, cache_index, 0, 0)
+    if "k_scale" in cache_kv:
+        k_q, k_s = quantize_kv(k)
+        v_q, v_s = quantize_kv(v)
+        new_kv = {
+            "k": jax.lax.dynamic_update_slice(cache_kv["k"], k_q, at),
+            "v": jax.lax.dynamic_update_slice(cache_kv["v"], v_q, at),
+            "k_scale": jax.lax.dynamic_update_slice(
+                cache_kv["k_scale"], k_s, at
+            ),
+            "v_scale": jax.lax.dynamic_update_slice(
+                cache_kv["v_scale"], v_s, at
+            ),
+        }
+        k = new_kv["k"].astype(dtype) * new_kv["k_scale"].astype(dtype)
+        v = new_kv["v"].astype(dtype) * new_kv["v_scale"].astype(dtype)
+        return k, v, new_kv
+    k = jax.lax.dynamic_update_slice(cache_kv["k"], k, at)
+    v = jax.lax.dynamic_update_slice(cache_kv["v"], v, at)
+    return k, v, {"k": k, "v": v}
+
+
+def kv_buffers(
+    n_layer: int,
+    batch_size: int,
+    capacity: int,
+    n_head: int,
+    head_dim: int,
+    dtype,
+    kv_cache_dtype: str = "bfloat16",
+) -> Cache:
+    """Per-layer fixed-capacity KV buffers, shared by every causal family.
+    ``"int8"`` stores int8 values + per (token, head) bf16 scales — ~half
+    the HBM traffic of a bf16 cache (`write_cache` handles both)."""
+    shape = (batch_size, capacity, n_head, head_dim)
+    if kv_cache_dtype == "int8":
+        sshape = (batch_size, capacity, n_head, 1)
         return tuple(
             {
                 "k": jnp.zeros(shape, jnp.int8),
@@ -295,9 +308,25 @@ def init_cache(config: GPT2Config, batch_size: int, capacity: int) -> Cache:
                 "k_scale": jnp.zeros(sshape, jnp.bfloat16),
                 "v_scale": jnp.zeros(sshape, jnp.bfloat16),
             }
-            for _ in range(config.n_layer)
+            for _ in range(n_layer)
+        )
+    if kv_cache_dtype != "bfloat16":
+        raise ValueError(
+            f"kv_cache_dtype={kv_cache_dtype!r} is not supported (choose "
+            "'bfloat16' or 'int8') — an unrecognized value would otherwise "
+            "silently fall back to bf16 buffers"
         )
     return tuple(
-        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-        for _ in range(config.n_layer)
+        {"k": jnp.zeros(shape, jnp.dtype(dtype)),
+         "v": jnp.zeros(shape, jnp.dtype(dtype))}
+        for _ in range(n_layer)
+    )
+
+
+def init_cache(config: GPT2Config, batch_size: int, capacity: int) -> Cache:
+    """Fixed-capacity KV buffers (one compile for the whole decode loop)."""
+    return kv_buffers(
+        config.n_layer, batch_size, capacity, config.n_head,
+        config.n_embd // config.n_head, config.dtype,
+        getattr(config, "kv_cache_dtype", "bfloat16"),
     )

@@ -36,6 +36,17 @@ class NeoXConfig:
     layer_norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
+    # rollout KV-cache storage ("bfloat16" | "int8"); see
+    # models/gpt2.py::write_cache — decode is HBM-bound and the
+    # cache is its dominant traffic, int8 halves it
+    kv_cache_dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        if self.kv_cache_dtype not in ("bfloat16", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={self.kv_cache_dtype!r} is not supported "
+                "(choose 'bfloat16' or 'int8')"
+            )
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "NeoXConfig":
@@ -90,9 +101,9 @@ class NeoXAttention(nn.Module):
 
         new_kv = None
         if cache_kv is not None:
-            k = jax.lax.dynamic_update_slice(cache_kv["k"], k, (0, cache_index, 0, 0))
-            v = jax.lax.dynamic_update_slice(cache_kv["v"], v, (0, cache_index, 0, 0))
-            new_kv = {"k": k, "v": v}
+            from trlx_tpu.models.gpt2 import write_cache
+
+            k, v, new_kv = write_cache(cache_kv, k, v, cache_index, dtype)
 
         out = dot_product_attention(q, k, v, bias, causal=causal)
         out = out.reshape(B, T, cfg.hidden_size)
@@ -221,10 +232,11 @@ class NeoXModel(nn.Module):
 
 
 def init_neox_cache(config: NeoXConfig, batch_size: int, capacity: int):
-    head_dim = config.hidden_size // config.num_attention_heads
-    shape = (batch_size, capacity, config.num_attention_heads, head_dim)
-    dtype = jnp.dtype(config.dtype)
-    return tuple(
-        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-        for _ in range(config.num_hidden_layers)
+    from trlx_tpu.models.gpt2 import kv_buffers
+
+    return kv_buffers(
+        config.num_hidden_layers, batch_size, capacity,
+        config.num_attention_heads,
+        config.hidden_size // config.num_attention_heads, config.dtype,
+        getattr(config, "kv_cache_dtype", "bfloat16"),
     )

@@ -187,9 +187,13 @@ class T5Attention(nn.Module):
             v = self.v(src).reshape(B, S, cfg.num_heads, cfg.d_kv)
             new_kv = None
             if cache_kv is not None:
-                k = jax.lax.dynamic_update_slice(cache_kv["k"], k, (0, cache_index, 0, 0))
-                v = jax.lax.dynamic_update_slice(cache_kv["v"], v, (0, cache_index, 0, 0))
-                new_kv = {"k": k, "v": v}
+                # shared cache write path (would make int8 a config flip
+                # for seq2seq decode too; t5 currently ships bf16 only)
+                from trlx_tpu.models.gpt2 import write_cache
+
+                k, v, new_kv = write_cache(
+                    cache_kv, k, v, cache_index, jnp.dtype(cfg.dtype)
+                )
 
         # T5 attention is unscaled: pre-multiply q by sqrt(d_kv) to cancel
         # the 1/sqrt(d) inside the shared attention core.
