@@ -297,3 +297,60 @@ def test_ilql_trains_moe_family_on_ep_mesh():
     assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
     wi = trainer.state.params["transformer"]["h_1"]["mlp"]["wi"]
     assert "ep" in wi.sharding.spec, wi.sharding.spec
+
+
+def test_grpo_moe_composes_on_dp_sp_ep_mesh():
+    """VERDICT r2 #10: the beyond-parity axes compose in ONE run — grouped
+    GRPO (no value function) training the switch-MoE family over a
+    dp=2 x sp=2 x ep=2 mesh, at realistic capacity (drops occur), with
+    the sp-sharded decode cache engaged. Learning must happen and no axis
+    may be silently ignored."""
+    os.environ["WANDB_DISABLED"] = "1"
+    import jax
+
+    import trlx_tpu
+
+    means = []
+
+    def reward_fn(samples, queries, response_gt=None):
+        scores = [sum(tok == "5" for tok in s.split()) / 4 for s in samples]
+        means.append(float(np.mean(scores)))
+        return scores
+
+    config = _config(
+        {"dp": 2, "fsdp": 1, "tp": 1, "sp": 2, "ep": 2},
+        method={
+            "name": "GRPOConfig",
+            "group_size": 4,
+            "num_rollouts": 32,
+            "chunk_size": 16,
+            "ppo_epochs": 2,
+            "init_kl_coef": 0.001,
+            "scale_reward": None,
+            "gen_kwargs": {
+                "max_new_tokens": 4, "min_new_tokens": 4, "top_k": 0,
+                "do_sample": True, "eos_token_id": 14, "pad_token_id": 15,
+            },
+        },
+        epochs=12, total_steps=48, trainer="GRPOTrainer",
+    )
+    config.model.model_arch = dict(
+        config.model.model_arch, capacity_factor=1.25
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=[[1, 2, 3, 4]] * 64, config=config
+    )
+    assert int(trainer.state.step) == 48
+    early = float(np.mean(means[:2]))
+    late = float(np.max(means[-4:]))
+    assert late > early + 0.15, (early, late, means)
+    # no axis silently ignored:
+    # ep — expert params sharded over the ep axis at rest
+    wi = trainer.state.params["transformer"]["h_1"]["mlp"]["wi"]
+    assert "ep" in wi.sharding.spec, wi.sharding.spec
+    # sp — the decode cache sharding pins the capacity axis over sp
+    sh = trainer._decode_cache_sharding()
+    assert sh is not None and "sp" in sh.spec, sh
+    # grpo — the trainer really ran grouped sampling with vf disabled
+    assert trainer.group_size == 4
+    assert float(trainer.config.method.vf_coef) == 0.0
