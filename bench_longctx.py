@@ -1,0 +1,313 @@
+"""Long-context hardware measurements on the real chip (VERDICT r2 #5).
+
+Puts measured numbers behind the long-context claims that round 2 verified
+only via compiled-HLO inspection:
+
+1. ``train_step``  — full gpt2-small LM fwd+bwd+AdamW step at T=1024/2048/4096
+   with the flash kernel engaged vs the XLA einsum path (token budget held
+   constant at B*T = 8192).
+2. ``attn_kernel`` — isolated causal attention fwd+bwd at the same shapes
+   plus 8k, flash vs XLA.
+3. ``decode``      — compiled sampler at a 2048-token prompt: prefill cost
+   (flash vs XLA — prefill attends the full cache) and per-generated-token
+   cost for bf16 vs int8 KV cache (R=16 vs R=64 differencing).
+4. ``ring_sp2``    — the sp=2 ring-attention *per-device critical path*
+   compute at T=4096 measured single-chip (the lagging device's two
+   2048x2048 blocks), vs the full-T single-device cost. ICI overlap cost is
+   NOT measurable on one chip; this grounds the compute half of the ring
+   claim and is labeled as such.
+
+Methodology (ROADMAP "measured, rejected" discipline): iterations chained
+inside ONE jit via lax.scan over K distinct inputs, single fetch, best of 3
+repeats — the tunnel's ~110 ms fetch and execution-cache traps make anything
+shorter unreliable. OOM on the XLA path is caught and recorded as a result
+("oom"), not an error: flash running where XLA cannot is the point.
+
+Writes LONGCTX.json and prints one JSON line per measurement.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import trlx_tpu.ops.attention as attention_mod
+from trlx_tpu.models.gpt2 import GPT2Config, GPT2Model, init_cache
+from trlx_tpu.ops.sampling import GenerationConfig, make_sampler
+
+FLASH_DEFAULT = attention_mod.FLASH_MIN_SEQ
+XLA_ONLY = 1 << 30
+
+
+def _set_mode(mode: str):
+    attention_mod.FLASH_MIN_SEQ = FLASH_DEFAULT if mode == "flash" else XLA_ONLY
+
+
+def _best_of(thunk, repeats=3):
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        thunk()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _scan_timed(step_fn, carry, xs, iters):
+    """Time ``iters`` chained executions of step_fn inside one jit."""
+
+    def run(carry, xs):
+        carry, out = jax.lax.scan(step_fn, carry, xs)
+        return jax.tree_util.tree_map(
+            lambda a: jnp.sum(a) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            out,
+        )
+
+    fn = jax.jit(run)
+    out = fn(carry, xs)  # compile + warmup
+    jax.block_until_ready(out)
+    sec = _best_of(lambda: jax.block_until_ready(fn(carry, xs)))
+    return sec / iters
+
+
+def measure_train_step(T, mode, rng):
+    """One full LM fwd+bwd+AdamW step; B*T held at 8192 tokens."""
+    _set_mode(mode)
+    B = max(8192 // T, 1)
+    cfg = GPT2Config(
+        vocab_size=50257, n_positions=4096, n_embd=768, n_layer=12, n_head=12
+    )
+    model = GPT2Model(cfg)
+    ids0 = jnp.asarray(rng.integers(0, 50000, size=(B, T)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids0)["params"]
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, ids):
+        out = model.apply({"params": params}, ids)
+        logits = out["logits"][:, :-1]
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(lp, ids[:, 1:, None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    def step(carry, ids):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), loss
+
+    K = 8
+    batches = jnp.asarray(rng.integers(0, 50000, size=(K, B, T)), jnp.int32)
+    try:
+        sec = _scan_timed(step, (params, opt_state), batches, K)
+    except Exception as e:  # XLA OOM at 4k without remat is a *result*
+        if "RESOURCE_EXHAUSTED" in str(e) or "memory" in str(e).lower():
+            return {"T": T, "B": B, "mode": mode, "result": "oom"}
+        raise
+    toks = B * T
+    return {
+        "T": T,
+        "B": B,
+        "mode": mode,
+        "ms_per_step": round(sec * 1e3, 2),
+        "tok_per_sec": round(toks / sec, 0),
+    }
+
+
+def measure_attn_kernel(T, mode, rng):
+    """Isolated causal attention fwd+bwd, [B=4, T, H=12, D=64]."""
+    _set_mode(mode)
+    B, H, D = 4, 12, 64
+    K = 4
+    shape = (K, B, T, H, D)
+    q = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+
+    def fwd(args):
+        q, k, v = args
+        return jnp.sum(
+            attention_mod.dot_product_attention(q, k, v, causal=True).astype(
+                jnp.float32
+            )
+        )
+
+    def step(carry, xs):
+        val, grads = jax.value_and_grad(fwd)(xs)
+        return carry, val + sum(
+            jnp.sum(g.astype(jnp.float32)) for g in grads
+        )
+
+    try:
+        sec = _scan_timed(step, 0.0, (q, k, v), K)
+    except Exception as e:
+        if "RESOURCE_EXHAUSTED" in str(e) or "memory" in str(e).lower():
+            return {"T": T, "B": B, "mode": mode, "result": "oom"}
+        raise
+    return {"T": T, "B": B, "mode": mode, "ms_per_fwdbwd": round(sec * 1e3, 3)}
+
+
+def measure_decode(kv_dtype, mode, rng):
+    """Sampler at Q=2048 prompt: per-token decode cost via R differencing."""
+    _set_mode(mode)
+    B, Q = 8, 2048
+    cfg = GPT2Config(
+        vocab_size=50257,
+        n_positions=4096,
+        n_embd=768,
+        n_layer=12,
+        n_head=12,
+        kv_cache_dtype=kv_dtype,
+    )
+    model = GPT2Model(cfg)
+    ids0 = jnp.asarray(rng.integers(0, 50000, size=(1, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids0)["params"]
+
+    def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
+                 cache=None, cache_index=None):
+        return model.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            position_ids=position_ids, cache=cache, cache_index=cache_index,
+        )
+
+    prompt = jnp.asarray(rng.integers(0, 50000, size=(B, Q)), jnp.int32)
+    mask = jnp.ones((B, Q), jnp.int32)
+    times = {}
+    for R in (16, 64):
+        gen = GenerationConfig(
+            max_new_tokens=R, min_new_tokens=R, do_sample=True, top_k=0,
+            eos_token_id=50256, pad_token_id=50256,
+        )
+        sampler = jax.jit(
+            make_sampler(apply_fn, lambda b, cap: init_cache(cfg, b, cap),
+                         gen, Q, with_values=False)
+        )
+        rngs = [jax.random.PRNGKey(i) for i in range(3)]
+        out = sampler(params, prompt, mask, rngs[0])
+        jax.block_until_ready(out.tokens)
+        times[R] = _best_of(
+            lambda: jax.block_until_ready(
+                sampler(params, prompt, mask, rngs[1]).tokens
+            )
+        )
+    per_tok_ms = (times[64] - times[16]) / 48 * 1e3
+    prefill_ms = (times[16] - 16 * (times[64] - times[16]) / 48) * 1e3
+    return {
+        "B": B,
+        "prompt_len": Q,
+        "kv_cache_dtype": kv_dtype,
+        "mode": mode,
+        "ms_per_decode_token": round(per_tok_ms, 3),
+        "prefill_ms": round(max(prefill_ms, 0.0), 2),
+    }
+
+
+def measure_ring_sp2(rng):
+    """sp=2 ring critical-path compute at T=4096, single-chip.
+
+    The lagging ring device (owner of q[2048:4096]) computes two
+    2048x2048 blocks: one full (vs the other shard's keys) and one causal
+    (its own). Measured as flash fwd+bwd; compared against the full-T
+    single-device flash cost. Ideal compute ratio is 0.75 (6M of 8M score
+    elements); the gap to ideal is blockwise overhead. ICI transfer/overlap
+    is not measurable on one chip and is excluded, as labeled.
+    """
+    _set_mode("flash")
+    B, H, D, T = 2, 12, 64, 4096
+    half = T // 2
+    K = 4
+    full = tuple(
+        jnp.asarray(rng.standard_normal((K, B, T, H, D)), jnp.bfloat16)
+        for _ in range(3)
+    )
+
+    def fwd_full(args):
+        q, k, v = args
+        return jnp.sum(
+            attention_mod.dot_product_attention(q, k, v, causal=True).astype(
+                jnp.float32
+            )
+        )
+
+    def step_full(c, xs):
+        val, grads = jax.value_and_grad(fwd_full)(xs)
+        return c, val + sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
+
+    sec_full = _scan_timed(step_full, 0.0, full, K)
+
+    def fwd_ring(args):
+        q, k, v = args  # [B, T, H, D]; device 1 owns the second half of q
+        q2 = q[:, half:]
+        o_remote = attention_mod.dot_product_attention(
+            q2, k[:, :half], v[:, :half], causal=False
+        )
+        o_local = attention_mod.dot_product_attention(
+            q2, k[:, half:], v[:, half:], causal=True
+        )
+        # combine cost (online-softmax lse merge) is negligible vs the
+        # blocks; summing both outputs keeps the timing honest about reads
+        return jnp.sum(o_remote.astype(jnp.float32)) + jnp.sum(
+            o_local.astype(jnp.float32)
+        )
+
+    def step_ring(c, xs):
+        val, grads = jax.value_and_grad(fwd_ring)(xs)
+        return c, val + sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
+
+    sec_ring = _scan_timed(step_ring, 0.0, full, K)
+    return {
+        "T": T,
+        "B": B,
+        "full_ms_per_fwdbwd": round(sec_full * 1e3, 3),
+        "ring_sp2_critical_path_ms": round(sec_ring * 1e3, 3),
+        "measured_ratio": round(sec_ring / sec_full, 3),
+        "ideal_compute_ratio": 0.75,
+        "caveat": "compute only, single-chip; ICI transfer/overlap excluded",
+    }
+
+
+def main():
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    results = {
+        "device_kind": dev.device_kind,
+        "backend": jax.default_backend(),
+        "train_step": [],
+        "attn_kernel": [],
+        "decode": [],
+    }
+    for T in (1024, 2048, 4096):
+        for mode in ("flash", "xla"):
+            r = measure_train_step(T, mode, rng)
+            results["train_step"].append(r)
+            print(json.dumps({"measurement": "train_step", **r}))
+    for T in (1024, 2048, 4096, 8192):
+        for mode in ("flash", "xla"):
+            r = measure_attn_kernel(T, mode, rng)
+            results["attn_kernel"].append(r)
+            print(json.dumps({"measurement": "attn_kernel", **r}))
+    for kv_dtype in ("bfloat16", "int8"):
+        for mode in ("flash", "xla"):
+            r = measure_decode(kv_dtype, mode, rng)
+            results["decode"].append(r)
+            print(json.dumps({"measurement": "decode", **r}))
+    r = measure_ring_sp2(rng)
+    results["ring_sp2"] = r
+    print(json.dumps({"measurement": "ring_sp2", **r}))
+    _set_mode("flash")
+
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "LONGCTX.json"), "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps({"written": "LONGCTX.json"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,7 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "examples"))
 
 
-def _config(mesh, **train_overrides):
+def _config(mesh, arch=None, **train_overrides):
     from trlx_tpu.data.configs import TRLConfig
 
     return TRLConfig.from_dict(
@@ -31,6 +31,7 @@ def _config(mesh, **train_overrides):
                     "n_embd": 32,
                     "n_layer": 4,
                     "n_head": 2,
+                    **(arch or {}),
                 },
             },
             "train": {
@@ -187,23 +188,27 @@ def test_pp_rejects_hydra_and_non_gpt2():
         get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
 
 
-def test_pp_decode_matches_plain_sampler():
+@pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
+def test_pp_decode_matches_plain_sampler(kv_dtype):
     """Round-3: rollout decode under pp runs the pipelined cached forward
     with stage-resident KV buffers (`pp_runner.pp_cached_hidden`) instead
     of a full replicated model per pp device. Same seed/params/rng as a
-    plain-mesh trainer => identical tokens, logprob/value parity."""
+    plain-mesh trainer => identical tokens, logprob/value parity. The int8
+    rollout cache composes: both meshes quantize identically, so parity
+    stays exact (value+scale leaves ride the stage/microbatch slicing)."""
     import jax
     import jax.numpy as jnp
 
     from trlx_tpu.utils.loading import get_trainer
 
     os.environ["WANDB_DISABLED"] = "1"
+    arch = {"kv_cache_dtype": kv_dtype}
     t_pp = get_trainer("PPOTrainer")(
-        _config({"dp": 2, "fsdp": 2, "tp": 1, "pp": 2}),
+        _config({"dp": 2, "fsdp": 2, "tp": 1, "pp": 2}, arch=arch),
         reward_fn=lambda **kw: [0.0],
     )
     t_pl = get_trainer("PPOTrainer")(
-        _config({"dp": -1, "fsdp": 1, "tp": 1}),
+        _config({"dp": -1, "fsdp": 1, "tp": 1}, arch=arch),
         reward_fn=lambda **kw: [0.0],
     )
     # same config.train.seed => identical init params on both meshes

@@ -165,10 +165,28 @@ def pp_ref_logits(
 
 def pp_init_cache(config: GPT2Config, batch_size: int, capacity: int):
     """Layer-major KV buffers for pp decode: ``{"k","v"}: [L, B, C, H, Dh]``
-    (vs the GSPMD sampler's per-layer tuple). bf16 storage; the int8
-    rollout-cache option does not yet compose with pp."""
+    (vs the GSPMD sampler's per-layer tuple). ``kv_cache_dtype="int8"``
+    composes: value+scale leaves, stage-sliced and microbatch-sliced like
+    any other cache leaf (`write_cache` keys on the ``k_scale`` entry, so
+    the per-layer dict the stage scan hands to ``Block`` is already in the
+    quantized layout)."""
     head_dim = config.n_embd // config.n_head
     shape = (config.n_layer, batch_size, capacity, config.n_head, head_dim)
+    kv_dtype = getattr(config, "kv_cache_dtype", "bfloat16")
+    if kv_dtype == "int8":
+        sshape = shape[:-1] + (1,)
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.bfloat16),
+            "v_scale": jnp.zeros(sshape, jnp.bfloat16),
+        }
+    if kv_dtype != "bfloat16":
+        # mirror kv_buffers: a future cache dtype (e.g. fp8) must fail loudly
+        # here rather than silently allocating bf16 stage buffers
+        raise ValueError(
+            f"kv_cache_dtype={kv_dtype!r} has no pp stage-resident layout yet"
+        )
     dtype = jnp.dtype(config.dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 

@@ -381,15 +381,6 @@ class PPOTrainer(BaseRLTrainer):
             )
             from trlx_tpu.parallel.mesh import BATCH_AXES
 
-            if getattr(self.model_config, "kv_cache_dtype", "bfloat16") != (
-                "bfloat16"
-            ):
-                raise NotImplementedError(
-                    f"kv_cache_dtype={self.model_config.kv_cache_dtype!r} "
-                    "does not compose with a pp mesh yet: the pp sampler's "
-                    "stage-resident cache stores bf16; drop the flag or "
-                    "the pp axis"
-                )
             inner = make_sampler(
                 make_pp_sampler_apply(
                     self.model_config, self.mesh, self.pp_microbatches
