@@ -1,0 +1,129 @@
+"""A/B: rollout-phase weight cast (bf16 copy) vs f32 masters, real TPU.
+
+Measures `train.rollout_param_cast` on the bench workload shape (gpt2-small,
+int8 KV cache, B=128, Q=64, R=48): the sampler re-reads every parameter once
+per generated token, so f32 masters cost 2x the weight HBM traffic of the
+bf16 compute-dtype copy the cast serves. Outputs are bit-identical
+(`tests/test_rollout_cast.py`); this script settles whether the traffic
+saving is wall-clock real.
+
+Methodology per `ab_int8_kv.py`: per measurement, queue K sampler dispatches
+on DISTINCT inputs (execution caching makes repeated identical calls free),
+force with ONE summed fetch (~110 ms flat), and interleave variants across
+rounds (wall-clock swings ±20% with machine load).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("WANDB_DISABLED", "1")
+
+import numpy as np
+
+
+def build_trainer(cast: bool):
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "model_arch": {
+                    "vocab_size": 50257, "n_positions": 1024, "n_embd": 768,
+                    "n_layer": 12, "n_head": 12, "kv_cache_dtype": "int8",
+                },
+            },
+            "train": {
+                "seq_length": 64, "batch_size": 16, "epochs": 1,
+                "total_steps": 10000, "eval_interval": 100000,
+                "checkpoint_interval": 1000000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1}, "dtype": "bfloat16",
+                "rollout_param_cast": cast,
+            },
+            "method": {
+                "name": "PPOConfig", "num_rollouts": 128, "chunk_size": 128,
+                "ppo_epochs": 4,
+                "gen_kwargs": {
+                    "max_new_tokens": 48, "min_new_tokens": 48, "top_k": 0,
+                    "do_sample": True, "eos_token_id": 50256,
+                    "pad_token_id": 50256,
+                },
+            },
+        }
+    )
+    return get_trainer(config.train.trainer)(
+        config, reward_fn=lambda **kw: [0.0]
+    )
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    B, Q, K = 128, 64, 10
+    rng = np.random.default_rng(0)
+
+    def fresh_batches(n):
+        return [
+            (
+                jnp.asarray(rng.integers(100, 40000, (B, Q)), jnp.int32),
+                jnp.ones((B, Q), jnp.int32),
+            )
+            for _ in range(n)
+        ]
+
+    trainers = {"f32": build_trainer(False), "cast": build_trainer(True)}
+
+    def measure(trainer, batches):
+        t0 = time.time()
+        acc = jnp.zeros((), jnp.int32)
+        for ids, mask in batches:
+            out = trainer.sample(ids, mask)
+            acc = acc + out.tokens.sum()
+        _ = int(acc)  # single forcing fetch
+        return time.time() - t0
+
+    def measure_ref(trainer, batches):
+        """score_ref also runs on the cast copy — time it too."""
+        t0 = time.time()
+        acc = jnp.zeros((), jnp.float32)
+        for ids, mask in batches:
+            r_ids = jnp.asarray(
+                rng.integers(100, 40000, (B, 48)), jnp.int32
+            )
+            r_mask = jnp.ones((B, 48), jnp.int32)
+            lp = trainer.score_ref(ids, mask, r_ids, r_mask)
+            acc = acc + lp.sum()
+        _ = float(acc)
+        return time.time() - t0
+
+    for t in trainers.values():  # warm the compiled paths
+        measure(t, fresh_batches(1))
+        measure_ref(t, fresh_batches(1))
+
+    rounds = {"f32": [], "cast": []}
+    ref_rounds = {"f32": [], "cast": []}
+    for r in range(6):
+        for name in ("f32", "cast") if r % 2 == 0 else ("cast", "f32"):
+            rounds[name].append(measure(trainers[name], fresh_batches(K)))
+            ref_rounds[name].append(
+                measure_ref(trainers[name], fresh_batches(K))
+            )
+    for label, data in (("sampler", rounds), ("score_ref", ref_rounds)):
+        for name, ts in data.items():
+            per_call = [(t - 0.11) / K for t in ts]
+            print(
+                f"{label}/{name}: per-call mean {np.mean(per_call)*1e3:.1f} ms  "
+                f"median {np.median(per_call)*1e3:.1f} ms  "
+                f"all {[round(x*1e3, 1) for x in per_call]}"
+            )
+    for label, data in (("sampler", rounds), ("score_ref", ref_rounds)):
+        speedup = np.median(data["f32"]) / np.median(data["cast"])
+        print(f"{label}: cast speedup over f32 masters: {speedup:.3f}x")
+
+
+if __name__ == "__main__":
+    main()

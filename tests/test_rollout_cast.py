@@ -1,0 +1,262 @@
+"""Rollout-phase weight cast (`train.rollout_param_cast`).
+
+Decode re-reads every parameter once per generated token, so serving the
+sampler f32 masters doubles its HBM traffic vs the bf16 compute dtype. The
+cast must be *bit-identical*: every causal-family op already casts params to
+the compute dtype per use (embedding adds round per-table first —
+`models/gpt2.py::embed`), and the leaves that genuinely compute in f32
+(value-head ``fc2``, MoE ``router``) are excluded.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _config(model_type, cast, arch=None):
+    from trlx_tpu.data.configs import TRLConfig
+
+    return TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": model_type,
+                "model_arch": {
+                    "vocab_size": 32,
+                    "n_positions": 32,
+                    "n_embd": 32,
+                    "n_layer": 2,
+                    "n_head": 2,
+                    **(arch or {}),
+                },
+            },
+            "train": {
+                "seq_length": 6,
+                "batch_size": 8,
+                "epochs": 1,
+                "total_steps": 4,
+                "eval_interval": 1000,
+                "checkpoint_interval": 100000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "bfloat16",
+                "seed": 3,
+                "rollout_param_cast": cast,
+            },
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 8,
+                "chunk_size": 8,
+                "ppo_epochs": 1,
+                "init_kl_coef": 0.01,
+                "scale_reward": None,
+                "gen_kwargs": {
+                    "max_new_tokens": 5,
+                    "min_new_tokens": 5,
+                    "top_k": 0,
+                    "do_sample": True,
+                    "eos_token_id": 30,
+                    "pad_token_id": 31,
+                },
+            },
+        }
+    )
+
+
+def _prompts():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    B, Q = 8, 6
+    ids = np.zeros((B, Q), np.int32)
+    mask = np.zeros((B, Q), np.int32)
+    for i in range(B):
+        L = rng.integers(2, Q + 1)
+        ids[i, Q - L :] = rng.integers(1, 30, size=L)
+        mask[i, Q - L :] = 1
+    return jnp.asarray(ids), jnp.asarray(mask)
+
+
+@pytest.mark.parametrize(
+    "model_type,arch",
+    [
+        ("gpt2", None),
+        ("gpt2_moe", {"n_experts": 2, "moe_every": 2, "capacity_factor": 4.0}),
+    ],
+)
+def test_cast_sampler_is_bit_identical(model_type, arch):
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    t_cast = get_trainer("PPOTrainer")(
+        _config(model_type, True, arch), reward_fn=lambda **kw: [0.0]
+    )
+    t_master = get_trainer("PPOTrainer")(
+        _config(model_type, False, arch), reward_fn=lambda **kw: [0.0]
+    )
+    assert t_cast._rollout_cast_jit is not None
+    assert t_master._rollout_cast_jit is None
+
+    # excluded leaves stay f32; everything else drops to bf16
+    rp = t_cast.rollout_params()
+    flat = jax.tree_util.tree_flatten_with_path(rp)[0]
+    assert any(l.dtype == jnp.bfloat16 for _, l in flat)
+    for path, leaf in flat:
+        keys = "/".join(str(getattr(p, "key", p)) for p in path)
+        if "fc2" in keys or "router" in keys:
+            assert leaf.dtype == jnp.float32, keys
+        elif jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.bfloat16, keys
+
+    ids, mask = _prompts()
+    key = jax.random.PRNGKey(11)
+    out_c = t_cast._sample_jit(t_cast.rollout_params(), ids, mask, key)
+    out_m = t_master._sample_jit(t_master.state.params, ids, mask, key)
+    np.testing.assert_array_equal(np.asarray(out_c.tokens), np.asarray(out_m.tokens))
+    np.testing.assert_array_equal(
+        np.asarray(out_c.logprobs), np.asarray(out_m.logprobs)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_c.values), np.asarray(out_m.values)
+    )
+
+    # frozen-ref scoring identical too (ref was cast once at construction);
+    # SampleOutput fields are [B, R] responses, re-entered via the host
+    # boundary as in the orchestrator
+    import jax.numpy as jnp
+
+    r_ids = jnp.asarray(np.asarray(out_c.tokens))
+    r_mask = jnp.asarray(np.asarray(out_c.response_mask))
+    lp_c = t_cast.score_ref(ids, mask, r_ids, r_mask)
+    lp_m = t_master.score_ref(ids, mask, r_ids, r_mask)
+    np.testing.assert_array_equal(np.asarray(lp_c), np.asarray(lp_m))
+
+
+def _ilql_config(cast):
+    from trlx_tpu.data.configs import TRLConfig
+
+    return TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "model_arch": {
+                    "vocab_size": 32,
+                    "n_positions": 32,
+                    "n_embd": 32,
+                    "n_layer": 2,
+                    "n_head": 2,
+                },
+            },
+            "train": {
+                "seq_length": 8,
+                "batch_size": 8,
+                "epochs": 1,
+                "total_steps": 2,
+                "eval_interval": 1000,
+                "checkpoint_interval": 100000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "bfloat16",
+                "seed": 3,
+                "rollout_param_cast": cast,
+                "orchestrator": "OfflineOrchestrator",
+                "trainer": "ILQLTrainer",
+            },
+            "method": {
+                "name": "ILQLConfig",
+                "gen_kwargs": {
+                    "max_new_tokens": 5,
+                    "do_sample": True,
+                    "top_k": 4,
+                    "eos_token_id": 30,
+                    "pad_token_id": 31,
+                },
+            },
+        }
+    )
+
+
+def test_ilql_cast_sampler_is_bit_identical():
+    """The β(Q−V) decode runs on the compute-dtype bundle (params +
+    target-Q) with identical tokens: trunk ops cast per use; the Q/V heads'
+    f32 ``fc2`` leaves are excluded."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    t_cast = get_trainer("ILQLTrainer")(_ilql_config(True))
+    t_master = get_trainer("ILQLTrainer")(_ilql_config(False))
+    assert t_cast._rollout_cast_jit is not None
+    assert t_master._rollout_cast_jit is None
+
+    bundle = t_cast.rollout_bundle()
+    flat = jax.tree_util.tree_flatten_with_path(bundle)[0]
+    assert any(l.dtype == jnp.bfloat16 for _, l in flat)
+    for path, leaf in flat:
+        keys = "/".join(str(getattr(p, "key", p)) for p in path)
+        if "fc2" in keys:
+            assert leaf.dtype == jnp.float32, keys
+
+    ids, mask = _prompts()
+    Q = t_cast.query_length  # seq_length - max_new_tokens
+    ids, mask = ids[:, :Q], jnp.ones_like(mask[:, :Q])
+    key = jax.random.PRNGKey(7)
+    out_c = t_cast._sample_jit(t_cast.rollout_bundle(), ids, mask, key)
+    out_m = t_master._sample_jit(
+        {
+            "params": t_master.state.params,
+            "target": t_master.state.target_q_params,
+        },
+        ids,
+        mask,
+        key,
+    )
+    np.testing.assert_array_equal(np.asarray(out_c.tokens), np.asarray(out_m.tokens))
+    np.testing.assert_array_equal(
+        np.asarray(out_c.logprobs), np.asarray(out_m.logprobs)
+    )
+
+
+def test_cast_refreshes_after_train_phase():
+    """TrainState replacement invalidates the cached compute-dtype copy; a
+    full collect+train phase through the public orchestrator path runs."""
+    from trlx_tpu.utils.loading import get_orchestrator, get_pipeline, get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    config = _config("gpt2", True)
+    t = get_trainer("PPOTrainer")(
+        config, reward_fn=lambda samples, queries, response_gt=None: [
+            float(len(set(s))) for s in samples
+        ]
+    )
+    first = t.rollout_params()
+    assert t.rollout_params() is first  # cached while params unchanged
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 30, size=4)) for _ in range(8)]
+    pipeline = get_pipeline(config.train.pipeline)(
+        prompts, config.train.seq_length
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        t,
+        pipeline,
+        reward_fn=lambda samples, queries, response_gt=None: [
+            float(len(set(s))) for s in samples
+        ],
+        chunk_size=config.method.chunk_size,
+    )
+    orch.make_experience(config.method.num_rollouts, 0)
+    assert t.rollout_params() is first  # collect did not touch the masters
+    t.train_on_buffer()
+    assert t.rollout_params() is not first  # recast from the new masters
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

@@ -122,6 +122,16 @@ class TrainConfig:
     pp_microbatches: int = 2
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
+    # Serve the rollout phase (sampler + frozen-ref scoring) a one-time
+    # compute-dtype copy of the master params instead of the f32 masters.
+    # Decode is HBM-bound and re-reads every parameter once per generated
+    # token, so when param_dtype=f32 and dtype=bf16 this halves decode
+    # weight traffic. Bit-identical outputs: every op already casts params
+    # to the compute dtype per use; leaves that genuinely compute in f32
+    # (value-head fc2, MoE router logits) are excluded from the cast.
+    # Causal families only — the seq2seq trainer keeps f32 (T5's RMSNorm
+    # scales / relative bias are consumed at f32).
+    rollout_param_cast: bool = True
 
     # when set, every collected rollout chunk is appended (one JSON line per
     # sample: query/response text + raw score) to rollouts_<iter>.jsonl here

@@ -171,8 +171,11 @@ class GPT2Model(nn.Module):
         )
 
     def embed(self, input_ids: jax.Array, position_ids: jax.Array) -> jax.Array:
+        # each table rounds to the compute dtype BEFORE the add, so the sum
+        # is invariant to whether params are stored f32 or pre-cast to the
+        # compute dtype (the rollout-phase weight cast relies on this)
         dtype = jnp.dtype(self.config.dtype)
-        return (self.wte(input_ids) + self.wpe(position_ids)).astype(dtype)
+        return self.wte(input_ids).astype(dtype) + self.wpe(position_ids).astype(dtype)
 
     def logits(self, hidden: jax.Array) -> jax.Array:
         """Tied LM head; logits in float32 for stable softmax/log-softmax."""

@@ -250,9 +250,12 @@ class GPTNeoModel(nn.Module):
                     )
                 else:
                     position_ids = jnp.arange(T)[None, :]
-            x = (self.wte(input_ids) + self.wpe(position_ids)).astype(
-                jnp.dtype(cfg.dtype)
-            )
+            # per-table rounding before the add: keeps the sum invariant to
+            # f32-master vs compute-dtype-cast params (rollout weight cast)
+            dtype = jnp.dtype(cfg.dtype)
+            x = self.wte(input_ids).astype(dtype) + self.wpe(
+                position_ids
+            ).astype(dtype)
 
         # global layers share the causal-LM dispatch; local layers always
         # need an explicit band bias (the window isn't expressible as the

@@ -158,7 +158,52 @@ class ILQLTrainer(BaseRLTrainer):
 
         self.store = None  # installed by OfflineOrchestrator
         self.setup_ep_axis(self.mesh, self.family)
+        self._setup_rollout_cast(train)
         self._build_jitted_fns()
+
+    def _setup_rollout_cast(self, train) -> None:
+        """Compute-dtype copy of the sampler bundle (params + target-Q) for
+        the β(Q−V) decode — same contract as the PPO trainer's
+        (`train.rollout_param_cast`): bit-identical (trunk ops cast per use;
+        MLPHead fc2 leaves stay f32) and half the per-token weight read."""
+        self._rollout_cast_jit = None
+        self._rollout_bundle_cache = None
+        cdtype = jnp.dtype(getattr(self.model_config, "dtype", train.dtype))
+        pdtype = jnp.dtype(
+            getattr(self.model_config, "param_dtype", train.param_dtype)
+        )
+        if (
+            not getattr(train, "rollout_param_cast", False)
+            or cdtype == pdtype
+        ):
+            return
+        from trlx_tpu.utils import compute_dtype_cast
+
+        bundle_shardings = {
+            "params": self.param_shardings,
+            "target": self.target_shardings,
+        }
+        self._rollout_cast_jit = jax.jit(
+            lambda bundle: compute_dtype_cast(bundle, cdtype),
+            in_shardings=(bundle_shardings,),
+            out_shardings=bundle_shardings,
+        )
+
+    def rollout_bundle(self):
+        """Sampler inputs: the compute-dtype copy when the cast is enabled
+        (recast lazily — ILQLTrainState is replaced on update, so object
+        identity detects staleness), else the f32 masters."""
+        master = {
+            "params": self.state.params,
+            "target": self.state.target_q_params,
+        }
+        if self._rollout_cast_jit is None:
+            return master
+        cache = self._rollout_bundle_cache
+        key = (master["params"], master["target"])
+        if cache is None or cache[0][0] is not key[0] or cache[0][1] is not key[1]:
+            self._rollout_bundle_cache = (key, self._rollout_cast_jit(master))
+        return self._rollout_bundle_cache[1]
 
     def _shardings_for(self, tree):
         specs = make_partition_specs(tree, self.mesh, self.family.partition_rules)
@@ -323,7 +368,7 @@ class ILQLTrainer(BaseRLTrainer):
     def sample(self, prompt_ids, prompt_mask):
         self.rng, key = jax.random.split(self.rng)
         return self._sample_jit(
-            {"params": self.state.params, "target": self.state.target_q_params},
+            self.rollout_bundle(),
             prompt_ids,
             prompt_mask,
             key,
@@ -404,6 +449,9 @@ class ILQLTrainer(BaseRLTrainer):
                     order[row : row + k], sharding=self._stacked_batch_sh
                 )
                 row += k
+                # free the compute-dtype sampler bundle through the train
+                # chunk (memory high-water mark); eval recasts lazily
+                self._rollout_bundle_cache = None
                 self.state, stacked = self._train_chunk_jit(self.state, mbs)
                 chunk_time = clock.tick(train.batch_size) / 1000.0
                 # one transfer event for the whole stacked stats tree

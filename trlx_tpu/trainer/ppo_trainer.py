@@ -268,7 +268,69 @@ class PPOTrainer(BaseRLTrainer):
         # training objective (collected via the "moe_losses" sow in
         # _forward_logprobs_values)
         self._moe_family = bool(getattr(self.family, "supports_ep", False))
+        self._setup_rollout_cast(train)
         self._build_jitted_fns()
+
+    # ------------------- rollout-phase weight precision ------------------ #
+
+    def _supports_rollout_cast(self) -> bool:
+        """Causal families keep bit-identical outputs under the cast (every
+        op casts params to the compute dtype per use; see TrainConfig).
+        Subclasses whose models consume f32 params directly override."""
+        return True
+
+    def _setup_rollout_cast(self, train) -> None:
+        """Build the jitted master->compute-dtype param cast for the rollout
+        phase (`rollout_param_cast`). Decode re-reads all weights once per
+        token, so f32 masters double its HBM traffic; the sampler and the
+        frozen ref instead get a compute-dtype copy, refreshed once per
+        collect phase. Leaves computing in f32 — value/Q-head ``fc2``, MoE
+        ``router`` — stay f32 so outputs are bit-identical."""
+        self._rollout_cast_jit = None
+        self._rollout_params_cache = None
+        cdtype = jnp.dtype(getattr(self.model_config, "dtype", train.dtype))
+        # the params' ACTUAL storage dtype is the arch's param_dtype (which
+        # model_arch may override independently of train.param_dtype)
+        pdtype = jnp.dtype(
+            getattr(self.model_config, "param_dtype", train.param_dtype)
+        )
+        if (
+            not getattr(train, "rollout_param_cast", False)
+            or not self._supports_rollout_cast()
+            or cdtype == pdtype
+        ):
+            return
+
+        from trlx_tpu.utils import compute_dtype_cast
+
+        def cast_tree(params):
+            return compute_dtype_cast(params, cdtype)
+
+        self._rollout_cast_jit = jax.jit(
+            cast_tree,
+            in_shardings=(self.param_shardings,),
+            out_shardings=self.param_shardings,
+        )
+        # the frozen ref is inference-only: cast once, permanently (also
+        # halves its resident memory)
+        self.ref_params = jax.jit(
+            cast_tree,
+            in_shardings=(self.ref_shardings,),
+            out_shardings=self.ref_shardings,
+        )(self.ref_params)
+
+    def rollout_params(self):
+        """Params the rollout phase runs on: the compute-dtype copy when the
+        cast is enabled (recast lazily after each train phase — TrainState
+        is replaced on update, so object identity detects staleness), else
+        the f32 masters."""
+        if self._rollout_cast_jit is None:
+            return self.state.params
+        master = self.state.params
+        cache = self._rollout_params_cache
+        if cache is None or cache[0] is not master:
+            self._rollout_params_cache = (master, self._rollout_cast_jit(master))
+        return self._rollout_params_cache[1]
 
     # ----------------------- model-family hooks ----------------------- #
 
@@ -656,11 +718,15 @@ class PPOTrainer(BaseRLTrainer):
     def sample(self, prompt_ids, prompt_mask) -> SampleOutput:
         """Run the compiled rollout sampler on a prompt batch."""
         self.rng, key = jax.random.split(self.rng)
-        return self._sample_jit(self.state.params, prompt_ids, prompt_mask, key)
+        return self._sample_jit(
+            self.rollout_params(), prompt_ids, prompt_mask, key
+        )
 
     def score_ref(self, q_ids, q_mask, r_ids, r_mask):
+        # policy params only feed the (frozen) hydra trunk here — the
+        # compute-dtype copy is exact for it, and halves the read
         return self._score_ref_jit(
-            self.ref_params, self.state.params, q_ids, q_mask, r_ids, r_mask
+            self.ref_params, self.rollout_params(), q_ids, q_mask, r_ids, r_mask
         )
 
     def compute_rewards(self, logprobs, ref_logprobs, response_mask, scores):
@@ -700,6 +766,10 @@ class PPOTrainer(BaseRLTrainer):
             sharding=self._stacked_batch_sh, repeat=method.ppo_epochs,
         )
         n_mb = len(self.buffer) // train.batch_size
+        # the compute-dtype rollout copy is dead weight through the train
+        # phase (the memory high-water mark); free it before dispatch —
+        # it is recast from the new masters at the next collect anyway
+        self._rollout_params_cache = None
         self.state, stats = self._train_phase_jit(self.state, mbs)
         kl_seq = [self.kl_coef]
         for _ in range(n_mb):

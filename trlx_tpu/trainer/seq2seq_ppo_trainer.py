@@ -62,6 +62,12 @@ def get_t5_arch(config: TRLConfig):
 class Seq2SeqPPOTrainer(PPOTrainer):
     backbone_key = "t5"
 
+    def _supports_rollout_cast(self) -> bool:
+        # T5 consumes f32 params directly (RMSNorm scales multiply the
+        # f32-normalized activation; RelPosBias feeds attention at f32), so
+        # a compute-dtype copy would not be bit-identical — keep masters
+        return False
+
     def _check_response_budget(self, train) -> None:
         # For seq2seq, gen max_length caps *decoder* tokens (incl. the
         # start token), independent of the encoder budget train.seq_length;

@@ -127,6 +127,35 @@ def to_device(tree: Any, device=None) -> Any:
     return jax.device_put(tree, device)
 
 
+# f32-consuming leaves excluded from the rollout-phase compute-dtype cast:
+# value/Q-head final layers (MLPHead "fc2" computes in f32 — value clipping
+# is sensitive to bf16 rounding) and MoE router logits.
+ROLLOUT_CAST_EXCLUDE = ("router", "fc2")
+
+
+def compute_dtype_cast(params: Any, compute_dtype) -> Any:
+    """Cast float param leaves to the compute dtype for the rollout phase.
+
+    Decode re-reads every parameter once per generated token; f32 masters
+    double that HBM traffic vs the compute dtype. Bit-identical outputs:
+    causal-family ops already cast params to the compute dtype per use
+    (embedding adds round per-table first), and leaves whose path matches
+    :data:`ROLLOUT_CAST_EXCLUDE` — the ones genuinely consumed at f32 —
+    keep their storage dtype. Jit with param shardings in/out so the copy
+    lands sharded like the masters (`train.rollout_param_cast`)."""
+    cdtype = jnp.dtype(compute_dtype)
+
+    def cast(path, leaf):
+        keys = "/".join(str(getattr(p, "key", p)) for p in path)
+        if any(ex in keys for ex in ROLLOUT_CAST_EXCLUDE):
+            return leaf
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(cdtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
 def filter_non_scalars(xs: Dict[str, Any]) -> Dict[str, float]:
     """Keep only entries castable to float — used before metric logging."""
     ys = {}
