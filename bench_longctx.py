@@ -75,75 +75,89 @@ def interleaved_rounds(variants, rounds=ROUNDS):
 # --------------------------- train step --------------------------------- #
 
 
-def build_train_step(T, mode, rng):
-    """Returns thunk(round) -> seconds for K chained LM train steps, or the
-    string "oom". B*T held at 8192 tokens."""
-    _set_mode(mode)
-    B = max(8192 // T, 1)
-    K = 8
+def _delete_tree(tree):
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "delete"):
+            leaf.delete()
+
+
+def measure_train_steps(rng):
+    """Per T: ONE params+opt_state (mode-independent, same seed) shared by
+    both mode thunks — the HBM is too small for two f32 master+Adam copies
+    alongside 2k-context XLA attention temps — and explicit buffer deletion
+    between T's (accumulated live buffers OOM'd the run otherwise)."""
+    out = []
     cfg = GPT2Config(
         vocab_size=50257, n_positions=4096, n_embd=768, n_layer=12, n_head=12
     )
     model = GPT2Model(cfg)
-    ids0 = jnp.asarray(rng.integers(0, 50000, size=(B, T)), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), ids0)["params"]
     tx = optax.adamw(1e-4)
-    opt_state = tx.init(params)
 
-    def loss_fn(params, ids):
-        out = model.apply({"params": params}, ids)
-        logits = out["logits"][:, :-1]
-        lp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(lp, ids[:, 1:, None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+    def make_run():
+        # a FRESH function object per (T, mode): jax.jit keys its global
+        # trace cache on the underlying callable, so a shared `run` would
+        # silently reuse the first mode's compiled program for both
+        def loss_fn(params, ids):
+            o = model.apply({"params": params}, ids)
+            lp = jax.nn.log_softmax(o["logits"][:, :-1], axis=-1)
+            ll = jnp.take_along_axis(lp, ids[:, 1:, None], axis=-1)[..., 0]
+            return -jnp.mean(ll)
 
-    def step(carry, ids):
-        params, opt_state = carry
-        loss, grads = jax.value_and_grad(loss_fn)(params, ids)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return (params, opt_state), loss
+        def step(carry, ids):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
 
-    def run(carry, xs):
-        _, losses = jax.lax.scan(step, carry, xs)
-        return jnp.sum(losses)
+        def run(carry, xs):
+            _, losses = jax.lax.scan(step, carry, xs)
+            return jnp.sum(losses)
 
-    fn = jax.jit(run)
+        return run
 
-    def fresh(seed):
-        x = jnp.asarray(
-            np.random.default_rng(seed).integers(0, 50000, size=(K, B, T)),
-            jnp.int32,
-        )
-        return jax.block_until_ready(x)
-
-    try:
-        jax.block_until_ready(fn((params, opt_state), fresh(10_000)))
-    except Exception as e:
-        if _is_oom(e):
-            return "oom", B, K
-        raise
-
-    def thunk(r):
-        xs = fresh(20_000 + r)
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn((params, opt_state), xs))
-        return time.perf_counter() - t0
-
-    return thunk, B, K
-
-
-def measure_train_steps(rng):
-    out = []
     for T in (1024, 2048, 4096):
-        built = {m: build_train_step(T, m, rng) for m in ("flash", "xla")}
-        variants = {
-            m: t for m, (t, _, _) in built.items() if not isinstance(t, str)
-        }
+        B = max(8192 // T, 1)
+        K = 8
+        ids0 = jnp.asarray(rng.integers(0, 50000, size=(B, T)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids0)["params"]
+        opt_state = tx.init(params)
+
+        def fresh(seed):
+            x = jnp.asarray(
+                np.random.default_rng(seed).integers(
+                    0, 50000, size=(K, B, T)
+                ),
+                jnp.int32,
+            )
+            return jax.block_until_ready(x)
+
+        variants = {}
+        status = {}
+        for mode in ("flash", "xla"):
+            _set_mode(mode)
+            fn = jax.jit(make_run())  # fresh callable per mode (see above)
+            try:
+                # real fetch: on the tunneled backend only a device->host
+                # transfer forces execution
+                float(fn((params, opt_state), fresh(10_000)))
+            except Exception as e:
+                if _is_oom(e):
+                    status[mode] = "oom"
+                    continue
+                raise
+
+            def thunk(r, fn=fn, mode=mode):
+                _set_mode(mode)
+                xs = fresh(20_000 + r)
+                t0 = time.perf_counter()
+                float(fn((params, opt_state), xs))
+                return time.perf_counter() - t0
+
+            variants[mode] = thunk
         best = interleaved_rounds(variants) if variants else {}
-        for m, (t, B, K) in built.items():
-            if isinstance(t, str):
-                rec = {"T": T, "B": B, "mode": m, "result": t}
+        for m in ("flash", "xla"):
+            if m in status:
+                rec = {"T": T, "B": B, "mode": m, "result": status[m]}
             else:
                 sec = (best[m] - FETCH_OVERHEAD_S) / K
                 rec = {
@@ -153,16 +167,20 @@ def measure_train_steps(rng):
                 }
             out.append(rec)
             print(json.dumps({"measurement": "train_step", **rec}))
+        _delete_tree((params, opt_state, ids0))
     return out
 
 
 # --------------------------- attention kernel ---------------------------- #
 
 
-def build_attn(T, mode, rng, B=4, H=12, D=64, K=4, composite=None):
+def build_attn(T, mode, rng, B=4, H=12, D=64, K=None, composite=None):
     """thunk(round) -> seconds for K chained causal-attn fwd+bwd, or "oom".
-    ``composite`` overrides the per-item forward (used by ring_sp2)."""
+    ``composite`` overrides the per-item forward (used by ring_sp2).
+    K scales inversely with T so small shapes amortize the ~110 ms fetch."""
     _set_mode(mode)
+    if K is None:
+        K = max(4, (4 * 4096) // T)
 
     def fwd(args):
         q, k, v = args
@@ -193,7 +211,7 @@ def build_attn(T, mode, rng, B=4, H=12, D=64, K=4, composite=None):
         return jax.tree_util.tree_map(jax.block_until_ready, xs)
 
     try:
-        jax.block_until_ready(fn(0.0, fresh(30_000 + T)))
+        float(fn(0.0, fresh(30_000 + T)))  # real fetch forces execution
     except Exception as e:
         if _is_oom(e):
             return "oom", K
@@ -202,7 +220,7 @@ def build_attn(T, mode, rng, B=4, H=12, D=64, K=4, composite=None):
     def thunk(r):
         xs = fresh(40_000 + 10 * T + r)
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(0.0, xs))
+        float(fn(0.0, xs))
         return time.perf_counter() - t0
 
     return thunk, K
@@ -233,9 +251,11 @@ def measure_attn_kernels(rng):
 # ------------------------------- decode ---------------------------------- #
 
 
-def build_decode(kv_dtype, R, rng, B=8, Q=2048):
+def build_decode(kv_dtype, R, rng, params, B=8, Q=2048):
     """thunk(round) -> seconds per sampler call (fetch-corrected): CALLS=3
-    chained distinct-prompt sampler dispatches, one forcing fetch."""
+    chained distinct-prompt sampler dispatches, one forcing fetch. ``params``
+    are shared across all four variants (identical seed; one f32 copy in
+    HBM instead of four)."""
     _set_mode("flash")
     CALLS = 3
     cfg = GPT2Config(
@@ -243,8 +263,6 @@ def build_decode(kv_dtype, R, rng, B=8, Q=2048):
         n_head=12, kv_cache_dtype=kv_dtype,
     )
     model = GPT2Model(cfg)
-    ids0 = jnp.asarray(rng.integers(0, 50000, size=(1, 8)), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), ids0)["params"]
 
     def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
                  cache=None, cache_index=None):
@@ -272,9 +290,9 @@ def build_decode(kv_dtype, R, rng, B=8, Q=2048):
             for _ in range(n)
         ]
 
-    jax.block_until_ready(
-        sampler(params, fresh(50_000, 1)[0], mask, jax.random.PRNGKey(0)).tokens
-    )
+    int(sampler(
+        params, fresh(50_000, 1)[0], mask, jax.random.PRNGKey(0)
+    ).tokens.sum())  # real fetch forces execution
 
     def thunk(r):
         prompts = fresh(60_000 + 100 * R + r)
@@ -284,7 +302,7 @@ def build_decode(kv_dtype, R, rng, B=8, Q=2048):
             acc = acc + sampler(
                 params, p, mask, jax.random.PRNGKey(1000 * r + i)
             ).tokens.sum()
-        jax.block_until_ready(acc)
+        int(acc)  # single forcing fetch
         return (time.perf_counter() - t0 - FETCH_OVERHEAD_S) / CALLS
 
     return thunk
@@ -292,11 +310,17 @@ def build_decode(kv_dtype, R, rng, B=8, Q=2048):
 
 def measure_decode(rng):
     out = []
+    cfg = GPT2Config(
+        vocab_size=50257, n_positions=4096, n_embd=768, n_layer=12, n_head=12
+    )
+    ids0 = jnp.asarray(rng.integers(0, 50000, size=(1, 8)), jnp.int32)
+    params = GPT2Model(cfg).init(jax.random.PRNGKey(0), ids0)["params"]
     variants = {}
     for kv in ("bfloat16", "int8"):
         for R in (16, 64):
-            variants[f"{kv}/{R}"] = build_decode(kv, R, rng)
+            variants[f"{kv}/{R}"] = build_decode(kv, R, rng, params)
     best = interleaved_rounds(variants)
+    _delete_tree((params, ids0))
     for kv in ("bfloat16", "int8"):
         t16, t64 = best[f"{kv}/16"], best[f"{kv}/64"]
         per_tok = (t64 - t16) / 48

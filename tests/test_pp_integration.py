@@ -18,19 +18,39 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "examples"))
 
 
-def _config(mesh, arch=None, **train_overrides):
+# tiny archs per causal family, all with 4 layers (pp=2 stages x 2) and the
+# family-specific twists pp must thread: rotary position_ids (gptj/neox),
+# alternating global/local band attention (gpt_neo)
+FAMILY_ARCHS = {
+    "gpt2": {
+        "vocab_size": 16, "n_positions": 16, "n_embd": 32,
+        "n_layer": 4, "n_head": 2,
+    },
+    "gptj": {
+        "vocab_size": 16, "n_positions": 16, "n_embd": 32,
+        "n_layer": 4, "n_head": 2, "rotary_dim": 8,
+    },
+    "gpt_neo": {
+        "vocab_size": 16, "max_position_embeddings": 16, "hidden_size": 32,
+        "num_layers": 4, "num_heads": 2, "window_size": 3,
+        "attention_layers": ["global", "local", "global", "local"],
+    },
+    "gpt_neox": {
+        "vocab_size": 16, "max_position_embeddings": 16, "hidden_size": 32,
+        "num_hidden_layers": 4, "num_attention_heads": 2, "rotary_pct": 0.5,
+    },
+}
+
+
+def _config(mesh, arch=None, model_type="gpt2", **train_overrides):
     from trlx_tpu.data.configs import TRLConfig
 
     return TRLConfig.from_dict(
         {
             "model": {
-                "model_type": "gpt2",
+                "model_type": model_type,
                 "model_arch": {
-                    "vocab_size": 16,
-                    "n_positions": 16,
-                    "n_embd": 32,
-                    "n_layer": 4,
-                    "n_head": 2,
+                    **FAMILY_ARCHS[model_type],
                     **(arch or {}),
                 },
             },
@@ -68,9 +88,12 @@ def _config(mesh, arch=None, **train_overrides):
     )
 
 
-def test_pp_forward_and_grads_match_plain():
+@pytest.mark.parametrize("model_type", list(FAMILY_ARCHS))
+def test_pp_forward_and_grads_match_plain(model_type):
     """pp_response_forward == response_forward (same params), including
-    gradients through the pipeline schedule."""
+    gradients through the pipeline schedule — for EVERY causal family
+    (round 3 widened pp beyond GPT-2: rotary aux for gptj/neox, per-layer
+    band-bias selection for gpt_neo)."""
     import jax
     import jax.flatten_util  # not exposed by `import jax` alone
     import jax.numpy as jnp
@@ -79,7 +102,9 @@ def test_pp_forward_and_grads_match_plain():
     from trlx_tpu.utils.loading import get_trainer
 
     os.environ["WANDB_DISABLED"] = "1"
-    config = _config({"dp": -1, "fsdp": 1, "tp": 1, "pp": 2})
+    config = _config(
+        {"dp": -1, "fsdp": 1, "tp": 1, "pp": 2}, model_type=model_type
+    )
     trainer = get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
     assert trainer.pp_stages == 2
 
@@ -169,7 +194,7 @@ def test_e2e_ppo_trains_on_dp_fsdp_pp_mesh():
     assert late > early + 0.15, (early, late, means)
 
 
-def test_pp_rejects_hydra_and_non_gpt2():
+def test_pp_rejects_hydra_and_moe():
     from trlx_tpu.utils.loading import get_trainer
 
     os.environ["WANDB_DISABLED"] = "1"
@@ -178,24 +203,36 @@ def test_pp_rejects_hydra_and_non_gpt2():
     with pytest.raises(NotImplementedError, match="hydra"):
         get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
 
+    # every causal family is pp-capable since round 3; MoE stays excluded
+    # (non-uniform per-layer params — no stage stacking)
     config = _config({"dp": -1, "fsdp": 1, "tp": 1, "pp": 2})
-    config.model.model_type = "gptj"
+    config.model.model_type = "gpt2_moe"
     config.model.model_arch = {
-        "vocab_size": 32, "n_positions": 16, "n_embd": 32,
-        "n_layer": 2, "n_head": 2, "rotary_dim": 8,
+        "vocab_size": 16, "n_positions": 16, "n_embd": 32,
+        "n_layer": 4, "n_head": 2, "n_experts": 2, "moe_every": 2,
     }
-    with pytest.raises(NotImplementedError, match="GPT-2"):
+    with pytest.raises(NotImplementedError, match="MoE"):
         get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
 
 
-@pytest.mark.parametrize("kv_dtype", ["bfloat16", "int8"])
-def test_pp_decode_matches_plain_sampler(kv_dtype):
+@pytest.mark.parametrize(
+    "model_type,kv_dtype",
+    [
+        ("gpt2", "bfloat16"),
+        ("gpt2", "int8"),
+        ("gptj", "bfloat16"),
+        ("gpt_neo", "bfloat16"),
+        ("gpt_neox", "int8"),
+    ],
+)
+def test_pp_decode_matches_plain_sampler(model_type, kv_dtype):
     """Round-3: rollout decode under pp runs the pipelined cached forward
     with stage-resident KV buffers (`pp_runner.pp_cached_hidden`) instead
-    of a full replicated model per pp device. Same seed/params/rng as a
-    plain-mesh trainer => identical tokens, logprob/value parity. The int8
-    rollout cache composes: both meshes quantize identically, so parity
-    stays exact (value+scale leaves ride the stage/microbatch slicing)."""
+    of a full replicated model per pp device — for every causal family.
+    Same seed/params/rng as a plain-mesh trainer => identical tokens,
+    logprob/value parity. The int8 rollout cache composes: both meshes
+    quantize identically, so parity stays exact (value+scale leaves ride
+    the stage/microbatch slicing)."""
     import jax
     import jax.numpy as jnp
 
@@ -204,11 +241,16 @@ def test_pp_decode_matches_plain_sampler(kv_dtype):
     os.environ["WANDB_DISABLED"] = "1"
     arch = {"kv_cache_dtype": kv_dtype}
     t_pp = get_trainer("PPOTrainer")(
-        _config({"dp": 2, "fsdp": 2, "tp": 1, "pp": 2}, arch=arch),
+        _config(
+            {"dp": 2, "fsdp": 2, "tp": 1, "pp": 2}, arch=arch,
+            model_type=model_type,
+        ),
         reward_fn=lambda **kw: [0.0],
     )
     t_pl = get_trainer("PPOTrainer")(
-        _config({"dp": -1, "fsdp": 1, "tp": 1}, arch=arch),
+        _config(
+            {"dp": -1, "fsdp": 1, "tp": 1}, arch=arch, model_type=model_type
+        ),
         reward_fn=lambda **kw: [0.0],
     )
     # same config.train.seed => identical init params on both meshes
@@ -247,6 +289,7 @@ def test_pp_decode_matches_plain_sampler(kv_dtype):
     # the pp cache really shards layers over the pp axis: peek via the
     # trainer's compiled sampler cache spec (init path)
     from trlx_tpu.models.pp_runner import pp_init_cache
+    from trlx_tpu.models.registry import num_layers_of
 
     cache = pp_init_cache(t_pp.model_config, B, Q + 6)
-    assert cache["k"].shape[0] == t_pp.model_config.n_layer
+    assert cache["k"].shape[0] == num_layers_of(t_pp.model_config)

@@ -1,12 +1,22 @@
-"""Pipeline-parallel trunk forward for the GPT-2 family.
+"""Pipeline-parallel trunk forward for the causal-LM families.
 
-Integrates ``parallel/pipeline.py``'s GPipe primitive into the real model:
+Integrates ``parallel/pipeline.py``'s GPipe primitive into the real models:
 the full-sequence forwards the PPO update runs (policy ``response_forward``
 and the frozen-ref scoring pass) route their transformer blocks through
 ``pipeline_apply`` over the mesh's ``pp`` axis, with embeddings and heads
 running replicated over pp. This makes ``mesh: {dp: ..., pp: ...}`` a real
 training capability rather than a standalone demo (the reference has no pp
-at all — SURVEY §2.9 "PP: NO"; this is the beyond-parity axis).
+at all — SURVEY §2.9 "PP: NO"; this is a beyond-parity axis).
+
+Family coverage (round 3 widened from GPT-2-only): **gpt2, gptj, gpt_neo,
+gpt_neox** — every causal family. The per-family differences ride a small
+kit: rotary families (gptj/neox) thread ``position_ids`` into each block
+via the schedule's aux tree; gpt_neo's alternating global/local (sliding
+window) layers select between two explicit biases with a per-layer flag
+scanned alongside the stage params. MoE (`gpt2_moe`) stays excluded — its
+per-layer param structure is non-uniform (router/experts on MoE layers
+only), so stage stacking does not apply; T5 is encoder-decoder and out of
+scope for the causal pipeline.
 
 Scope and composition:
 - Stage s runs blocks ``[s*L/S, (s+1)*L/S)`` with an in-stage ``lax.scan``;
@@ -16,14 +26,18 @@ Scope and composition:
   over fsdp at the shard_map boundary (`parallel/pipeline.py`): pp shards
   params/compute *across stages*; fsdp shards the at-rest copy and the
   optimizer state, not the running stage's working set.
-- Autoregressive decode (round 3) runs the SAME pipeline schedule with
+- Autoregressive decode runs the SAME pipeline schedule with
   stage-resident KV caches: the sampler's cache is layer-major
-  ``[L, B, C, H, Dh]`` sharded over pp, so each device holds only its
-  stage's layers and cache during rollouts (``pp_cached_hidden`` /
-  ``make_pp_sampler_apply`` below) — no replicated full-model copy.
+  ``[L, B, C, H, Dh]`` sharded over pp (bf16 or int8 value+scale leaves),
+  so each device holds only its stage's layers and cache during rollouts
+  (``pp_cached_hidden`` / ``make_pp_sampler_apply`` below) — no replicated
+  full-model copy.
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,12 +45,44 @@ from jax.sharding import Mesh
 
 from trlx_tpu.models.gpt2 import Block, GPT2Config, GPT2Model
 from trlx_tpu.models.heads import MLPHead
-from trlx_tpu.ops.attention import causal_dispatch
+from trlx_tpu.models.registry import hidden_size_of, n_heads_of, num_layers_of
+from trlx_tpu.ops.attention import (
+    causal_bias,
+    combine_biases,
+    padding_bias,
+)
 from trlx_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
 
+@dataclass(frozen=True)
+class _PPKit:
+    """Family adapter for the pipeline schedule."""
+
+    backbone_cls: Any
+    block_cls: Any
+    takes_positions: bool  # block signature threads position_ids (rotary)
+    has_wpe: bool  # embed = wte + wpe (else wte only)
+    windowed: bool  # per-layer global/local band attention (gpt_neo)
+
+
+def _pp_kit(config) -> Optional[_PPKit]:
+    from trlx_tpu.models.gpt_neo import GPTNeoBlock, GPTNeoConfig, GPTNeoModel
+    from trlx_tpu.models.gptj import GPTJBlock, GPTJConfig, GPTJModel
+    from trlx_tpu.models.neox import NeoXBlock, NeoXConfig, NeoXModel
+
+    if isinstance(config, GPT2Config):
+        return _PPKit(GPT2Model, Block, False, True, False)
+    if isinstance(config, GPTJConfig):
+        return _PPKit(GPTJModel, GPTJBlock, True, False, False)
+    if isinstance(config, GPTNeoConfig):
+        return _PPKit(GPTNeoModel, GPTNeoBlock, False, True, True)
+    if isinstance(config, NeoXConfig):
+        return _PPKit(NeoXModel, NeoXBlock, True, False, False)
+    return None
+
+
 def supports_pp(model_config) -> bool:
-    return isinstance(model_config, GPT2Config)
+    return _pp_kit(model_config) is not None
 
 
 def _stack_stages(block_params, stages: int):
@@ -52,8 +98,80 @@ def _stack_stages(block_params, stages: int):
     return stack_stage_params(stage_trees)
 
 
+def _local_flags(config, stages: int) -> Optional[jax.Array]:
+    """gpt_neo per-layer local-attention flags, stage-stacked [S, L/S]."""
+    types = config.layer_types
+    flags = [jnp.asarray(t == "local") for t in types]
+    return _stack_stages(flags, stages)
+
+
+def _embed(kit: _PPKit, config, backbone_params, input_ids, position_ids):
+    """Token (+ absolute position) embedding via the family's own tables;
+    per-table rounding to the compute dtype (matches the backbones)."""
+    dtype = jnp.dtype(config.dtype)
+    backbone = kit.backbone_cls(config)
+    if kit.has_wpe:
+        return backbone.apply(
+            {"params": backbone_params}, input_ids, position_ids,
+            method=lambda m, i, p: m.wte(i).astype(dtype)
+            + m.wpe(p).astype(dtype),
+        )
+    return backbone.apply(
+        {"params": backbone_params}, input_ids,
+        method=lambda m, i: m.wte(i).astype(dtype),
+    )
+
+
+def _ln_f(kit: _PPKit, config, backbone_params, h):
+    return kit.backbone_cls(config).apply(
+        {"params": backbone_params}, h, method=lambda m, v: m.ln_f(v)
+    )
+
+
+def _logits(kit: _PPKit, config, backbone_params, hidden: jax.Array):
+    """LM head on (already-sliced) hidden states via the family's own
+    ``logits`` definition (tied wte or separate lm_head)."""
+    cls = kit.backbone_cls
+    return cls(config).apply(
+        {"params": backbone_params}, hidden, method=cls.logits
+    )
+
+
+def _neo_local_bias(config, T, kv_len, offset, pad):
+    from trlx_tpu.models.gpt_neo import local_causal_bias
+
+    return combine_biases(
+        local_causal_bias(T, kv_len, config.window_size, offset=offset), pad
+    )
+
+
+def _stage_body(kit: _PPKit, block, aux_mb, causal: bool, cached: bool):
+    """One scan body serving both schedules: unpack per-layer xs (params
+    [+ cache slice] [+ local flag]), select the bias (windowed families pick
+    per layer between aux "local" and "global"), thread rotary positions.
+    Cached mode reads ``aux_mb["idx"]`` as the cache write index."""
+
+    def body(h, xs):
+        if kit.windowed:
+            (p, *rest, flag) = xs
+            bias = jnp.where(flag, aux_mb["local"], aux_mb["global"])
+        else:
+            (p, *rest) = xs if cached else (xs,)
+            bias = aux_mb["global"]
+        args = (h, bias) + ((aux_mb["pos"],) if kit.takes_positions else ())
+        if cached:
+            return block.apply(
+                {"params": p}, *args, cache_kv=rest[0],
+                cache_index=aux_mb["idx"], causal=False,
+            )
+        h, _ = block.apply({"params": p}, *args, causal=causal)
+        return h, None
+
+    return body
+
+
 def pp_hidden_forward(
-    config: GPT2Config,
+    config,
     backbone_params,
     input_ids: jax.Array,  # [B, T]
     attention_mask: jax.Array,  # [B, T]
@@ -61,56 +179,67 @@ def pp_hidden_forward(
     num_microbatches: int = 2,
 ) -> jax.Array:
     """Full-sequence causal trunk forward (embed -> pp blocks -> ln_f),
-    numerically identical to ``GPT2Model.__call__`` with ``cache=None``.
-    Embedding / ln_f / heads reuse the flax module methods (one definition)
-    — only the block loop is replaced by the pipeline schedule."""
-    S = mesh.shape["pp"]
-    if config.n_layer % S:
-        raise ValueError(
-            f"n_layer={config.n_layer} must divide into pp={S} stages"
+    numerically identical to the family backbone's ``__call__`` with
+    ``cache=None``. Embedding / ln_f / heads reuse the flax module methods
+    (one definition) — only the block loop is replaced by the pipeline
+    schedule. Rotary position_ids and gpt_neo's per-layer band biases ride
+    the schedule's aux tree."""
+    kit = _pp_kit(config)
+    if kit is None:
+        raise NotImplementedError(
+            f"pp is not available for {type(config).__name__}"
         )
-    backbone = GPT2Model(config)
+    S = mesh.shape["pp"]
+    L = num_layers_of(config)
+    if L % S:
+        raise ValueError(f"n_layer={L} must divide into pp={S} stages")
+    B, T = input_ids.shape
     position_ids = jnp.clip(jnp.cumsum(attention_mask, axis=-1) - 1, 0, None)
-    x = backbone.apply(
-        {"params": backbone_params}, input_ids, position_ids,
-        method=GPT2Model.embed,
-    )
-    bias, causal = causal_dispatch(
-        input_ids.shape[1], None, None, attention_mask
-    )
+    x = _embed(kit, config, backbone_params, input_ids, position_ids)
+
+    pad = padding_bias(attention_mask)
+    if kit.windowed:
+        # the causal FLAG cannot vary per scanned layer, so the windowed
+        # family uses explicit biases for all layers (same mask values)
+        aux = {
+            "global": jnp.broadcast_to(
+                combine_biases(causal_bias(T, T), pad),
+                (B, 1, T, T),
+            ),
+            "local": jnp.broadcast_to(
+                _neo_local_bias(config, T, T, 0, pad), (B, 1, T, T)
+            ),
+        }
+        causal = False
+    else:
+        aux = {"global": pad}
+        causal = True
+    if kit.takes_positions:
+        aux["pos"] = position_ids
 
     stacked = _stack_stages(
-        [backbone_params[f"h_{i}"] for i in range(config.n_layer)], S
+        [backbone_params[f"h_{i}"] for i in range(L)], S
     )
-    block = Block(config)
+    flags = _local_flags(config, S) if kit.windowed else None
+    block = kit.block_cls(config)
 
-    def stage_fn(stage_params, h, bias_mb):
-        def body(h, p):
-            h, _ = block.apply({"params": p}, h, bias_mb, causal=causal)
-            return h, None
-
-        h, _ = jax.lax.scan(body, h, stage_params)
+    def stage_fn(stage_params, h, aux_mb):
+        params, lflags = stage_params if kit.windowed else (stage_params, None)
+        body = _stage_body(kit, block, aux_mb, causal, cached=False)
+        xs = (params, lflags) if kit.windowed else params
+        h, _ = jax.lax.scan(body, h, xs)
         return h
 
+    stage_tree = (stacked, flags) if kit.windowed else stacked
     h = pipeline_apply(
-        stage_fn, stacked, x, mesh,
-        num_microbatches=num_microbatches, aux=bias,
+        stage_fn, stage_tree, x, mesh,
+        num_microbatches=num_microbatches, aux=aux,
     )
-    return backbone.apply(
-        {"params": backbone_params}, h, method=lambda m, v: m.ln_f(v)
-    )
-
-
-def _logits(config: GPT2Config, backbone_params, hidden: jax.Array):
-    """Tied LM head on (already-sliced) hidden states via the module's own
-    definition (``GPT2Model.logits``)."""
-    return GPT2Model(config).apply(
-        {"params": backbone_params}, hidden, method=GPT2Model.logits
-    )
+    return _ln_f(kit, config, backbone_params, h)
 
 
 def pp_response_forward(
-    config: GPT2Config,
+    config,
     params,  # CausalLMWithValueHead params: {"transformer", "v_head"}
     input_ids: jax.Array,
     attention_mask: jax.Array,
@@ -120,20 +249,22 @@ def pp_response_forward(
 ):
     """pp counterpart of ``CausalLMWithValueHead.response_forward``:
     (logits, values) over the response-predicting positions Q-1..Q+R-2."""
+    kit = _pp_kit(config)
     h = pp_hidden_forward(
         config, params["transformer"], input_ids, attention_mask,
         mesh, num_microbatches,
     )
     hs = h[:, query_length - 1 : -1]
     v_head = MLPHead(
-        config.n_embd, 1, dtype=config.dtype, param_dtype=config.param_dtype
+        hidden_size_of(config), 1, dtype=config.dtype,
+        param_dtype=config.param_dtype,
     )
     values = v_head.apply({"params": params["v_head"]}, hs)[..., 0]
-    return _logits(config, params["transformer"], hs), values
+    return _logits(kit, config, params["transformer"], hs), values
 
 
 def pp_ref_logits(
-    config: GPT2Config,
+    config,
     backbone_params,
     input_ids: jax.Array,
     attention_mask: jax.Array,
@@ -144,34 +275,37 @@ def pp_ref_logits(
     """Frozen-reference logits over response-predicting positions (the
     full-copy ref path; hydra's shared-trunk branch is not offered under
     pp — the trunk capture point sits mid-pipeline)."""
+    kit = _pp_kit(config)
     h = pp_hidden_forward(
         config, backbone_params, input_ids, attention_mask,
         mesh, num_microbatches,
     )
-    return _logits(config, backbone_params, h[:, query_length - 1 : -1])
+    return _logits(kit, config, backbone_params, h[:, query_length - 1 : -1])
 
 
 # --------------------------- pp rollout decode --------------------------- #
 #
-# Round 3: decode under a pp mesh no longer replicates the full model per
-# device. The sampler's KV cache becomes layer-major [L, B, C, H, Dh]
-# sharded P(pp, (dp, fsdp)) — each device holds the cache AND compute of
-# its own stage's L/S layers only — and every sampler forward (prefill +
-# each decode token) runs the GPipe schedule with the cache resident in
-# the stages (`parallel/pipeline.py::pipeline_apply_cached`). Embedding,
-# ln_f, LM head, and the value head stay replicated over pp (they are a
-# small fraction of weights and need the full batch anyway).
+# Decode under a pp mesh does not replicate the full model per device. The
+# sampler's KV cache becomes layer-major [L, B, C, H, Dh] sharded
+# P(pp, (dp, fsdp)) — each device holds the cache AND compute of its own
+# stage's L/S layers only — and every sampler forward (prefill + each decode
+# token) runs the GPipe schedule with the cache resident in the stages
+# (`parallel/pipeline.py::pipeline_apply_cached`). Embedding, ln_f, LM head,
+# and the value head stay replicated over pp (they are a small fraction of
+# weights and need the full batch anyway).
 
 
-def pp_init_cache(config: GPT2Config, batch_size: int, capacity: int):
+def pp_init_cache(config, batch_size: int, capacity: int):
     """Layer-major KV buffers for pp decode: ``{"k","v"}: [L, B, C, H, Dh]``
     (vs the GSPMD sampler's per-layer tuple). ``kv_cache_dtype="int8"``
     composes: value+scale leaves, stage-sliced and microbatch-sliced like
     any other cache leaf (`write_cache` keys on the ``k_scale`` entry, so
-    the per-layer dict the stage scan hands to ``Block`` is already in the
+    the per-layer dict the stage scan hands to the block is already in the
     quantized layout)."""
-    head_dim = config.n_embd // config.n_head
-    shape = (config.n_layer, batch_size, capacity, config.n_head, head_dim)
+    L = num_layers_of(config)
+    H = n_heads_of(config)
+    head_dim = hidden_size_of(config) // H
+    shape = (L, batch_size, capacity, H, head_dim)
     kv_dtype = getattr(config, "kv_cache_dtype", "bfloat16")
     if kv_dtype == "int8":
         sshape = shape[:-1] + (1,)
@@ -191,18 +325,19 @@ def pp_init_cache(config: GPT2Config, batch_size: int, capacity: int):
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def pp_stack_sampler_params(config: GPT2Config, mesh: Mesh, params):
+def pp_stack_sampler_params(config, mesh: Mesh, params):
     """Pre-stack the trunk blocks for the pp sampler, ONCE per sampler
     invocation (outside the decode scan): the jnp.stack of every layer and
     the regather to P('pp') residency are loop-invariant, and leaving them
     inside the per-token apply would rely on XLA hoisting them out of the
-    while-loop body (round-3 review). Returns the packed params pytree the
+    while-loop body. Returns the packed params pytree the
     ``make_pp_sampler_apply`` closure expects."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     S = mesh.shape["pp"]
     stacked = _stack_stages(
-        [params["transformer"][f"h_{i}"] for i in range(config.n_layer)], S
+        [params["transformer"][f"h_{i}"] for i in range(num_layers_of(config))],
+        S,
     )
     stacked = jax.tree_util.tree_map(
         lambda p: jax.lax.with_sharding_constraint(
@@ -218,7 +353,7 @@ def pp_stack_sampler_params(config: GPT2Config, mesh: Mesh, params):
 
 
 def pp_cached_hidden(
-    config: GPT2Config,
+    config,
     backbone_params,
     input_ids: jax.Array,  # [B, T]
     attention_mask: jax.Array,  # [B, C] cache-validity mask
@@ -231,57 +366,67 @@ def pp_cached_hidden(
 ):
     """(hidden after ln_f, new cache) for a cached forward (prefill T=Q or
     decode T=1) with blocks pipelined over pp and stage-resident caches."""
-    from trlx_tpu.ops.attention import causal_bias, combine_biases, padding_bias
     from trlx_tpu.parallel.pipeline import pipeline_apply_cached
 
+    kit = _pp_kit(config)
+    if kit is None:
+        raise NotImplementedError(
+            f"pp is not available for {type(config).__name__}"
+        )
     S = mesh.shape["pp"]
-    if config.n_layer % S:
-        raise ValueError(f"n_layer={config.n_layer} must divide pp={S}")
-    backbone = GPT2Model(config)
-    x = backbone.apply(
-        {"params": backbone_params}, input_ids, position_ids,
-        method=GPT2Model.embed,
-    )
+    L = num_layers_of(config)
+    if L % S:
+        raise ValueError(f"n_layer={L} must divide pp={S}")
+    x = _embed(kit, config, backbone_params, input_ids, position_ids)
     T = input_ids.shape[1]
     C = cache["k"].shape[2]
     B = input_ids.shape[0]
-    # explicit per-row bias (aux rides microbatch slicing, so batch-lead it)
-    bias = combine_biases(
-        causal_bias(T, C, offset=cache_index), padding_bias(attention_mask)
-    )
-    bias = jnp.broadcast_to(bias, (B,) + bias.shape[1:])
+    # explicit per-row biases (aux rides microbatch slicing, so batch-lead)
+    pad = padding_bias(attention_mask)
+    aux = {
+        "global": jnp.broadcast_to(
+            combine_biases(causal_bias(T, C, offset=cache_index), pad),
+            (B, 1, T, C),
+        )
+    }
+    if kit.windowed:
+        aux["local"] = jnp.broadcast_to(
+            _neo_local_bias(config, T, C, cache_index, pad), (B, 1, T, C)
+        )
+    if kit.takes_positions:
+        aux["pos"] = position_ids
 
     if stacked is None:
         stacked = _stack_stages(
-            [backbone_params[f"h_{i}"] for i in range(config.n_layer)], S
+            [backbone_params[f"h_{i}"] for i in range(L)], S
         )
-    block = Block(config)
+    flags = _local_flags(config, S) if kit.windowed else None
+    block = kit.block_cls(config)
 
-    def stage_fn(stage_params, h, bias_mb, stage_cache_mb, idx):
-        # stage_cache_mb leaves [L/S, bm, C, H, Dh]: scan layers, thread h
-        def body(h, xs):
-            p, kv = xs
-            h, new_kv = block.apply(
-                {"params": p}, h, bias_mb, cache_kv=kv, cache_index=idx,
-                causal=False,
-            )
-            return h, new_kv
-
-        h, new_kvs = jax.lax.scan(body, h, (stage_params, stage_cache_mb))
+    def stage_fn(stage_params, h, aux_mb, stage_cache_mb, idx):
+        # stage_cache_mb leaves [L/S, bm, C, ...]: scan layers, thread h
+        params, lflags = stage_params if kit.windowed else (stage_params, None)
+        body = _stage_body(
+            kit, block, {**aux_mb, "idx": idx}, causal=False, cached=True
+        )
+        xs = (
+            (params, stage_cache_mb, lflags)
+            if kit.windowed
+            else (params, stage_cache_mb)
+        )
+        h, new_kvs = jax.lax.scan(body, h, xs)
         return h, new_kvs
 
+    stage_tree = (stacked, flags) if kit.windowed else stacked
     h, new_cache = pipeline_apply_cached(
-        stage_fn, stacked, x, cache, cache_index, mesh,
-        num_microbatches=num_microbatches, aux=bias,
+        stage_fn, stage_tree, x, cache, cache_index, mesh,
+        num_microbatches=num_microbatches, aux=aux,
     )
-    h = backbone.apply(
-        {"params": backbone_params}, h, method=lambda m, v: m.ln_f(v)
-    )
-    return h, new_cache
+    return _ln_f(kit, config, backbone_params, h), new_cache
 
 
 def make_pp_sampler_apply(
-    config: GPT2Config,
+    config,
     mesh: Mesh,
     num_microbatches: int = 2,
 ):
@@ -294,10 +439,10 @@ def make_pp_sampler_apply(
     sampler invocation, not once per decoded token). Logits/values are
     computed at the LAST position only (shape [B, 1, ...]), which is all
     the sampler reads for both prefill and decode."""
-    from trlx_tpu.models.heads import MLPHead
-
+    kit = _pp_kit(config)
     v_head = MLPHead(
-        config.n_embd, 1, dtype=config.dtype, param_dtype=config.param_dtype
+        hidden_size_of(config), 1, dtype=config.dtype,
+        param_dtype=config.param_dtype,
     )
 
     def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
@@ -308,7 +453,7 @@ def make_pp_sampler_apply(
             stacked=params["stacked_blocks"],
         )
         hs = h[:, -1:]
-        logits = _logits(config, params["transformer"], hs)
+        logits = _logits(kit, config, params["transformer"], hs)
         values = v_head.apply({"params": params["v_head"]}, hs)[..., 0]
         return {"logits": logits, "values": values, "cache": new_cache}
 

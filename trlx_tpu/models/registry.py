@@ -54,6 +54,13 @@ def hidden_size_of(config: Any) -> int:
     raise ValueError(f"no hidden size on {type(config).__name__}")
 
 
+def n_heads_of(config: Any) -> int:
+    for attr in ("n_head", "num_heads", "num_attention_heads"):
+        if hasattr(config, attr):
+            return getattr(config, attr)
+    raise ValueError(f"no head count on {type(config).__name__}")
+
+
 def num_layers_of(config: Any) -> int:
     # order matters: T5 has both num_layers (encoder) and num_decoder_layers —
     # trainers freeze/branch on the decoder stack, so it takes precedence

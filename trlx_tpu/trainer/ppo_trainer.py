@@ -173,9 +173,11 @@ class PPOTrainer(BaseRLTrainer):
 
             if not supports_pp(self.model_config):
                 raise NotImplementedError(
-                    f"pp mesh axis is integrated for the GPT-2 family only "
-                    f"(got {type(self.model_config).__name__}); use "
-                    f"dp/fsdp/tp/sp for other families"
+                    f"pp mesh axis is integrated for the causal families "
+                    f"(gpt2/gptj/gpt_neo/gpt_neox) but not "
+                    f"{type(self.model_config).__name__}: MoE layers have "
+                    f"non-uniform per-layer params (no stage stacking); "
+                    f"use dp/fsdp/tp/sp/ep instead"
                 )
             if config.model.num_layers_unfrozen > 0:
                 raise NotImplementedError(
