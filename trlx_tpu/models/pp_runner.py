@@ -283,6 +283,75 @@ def pp_ref_logits(
     return _logits(kit, config, backbone_params, h[:, query_length - 1 : -1])
 
 
+def pp_ilql_forward(
+    config,
+    params,  # CausalLMWithILQLHeads params: {"transformer", "heads"}
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    actions_ixs: Optional[jax.Array],
+    states_ixs: Optional[jax.Array],
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    two_qs: bool = True,
+):
+    """pp counterpart of ``CausalLMWithILQLHeads.__call__`` (no cache):
+    trunk blocks through the GPipe schedule; logits and the Q/V heads run
+    replicated over pp on the gathered positions. Returns the same dict
+    the flax module's forward does (`models/heads.py`)."""
+    from trlx_tpu.models.heads import ILQLHeads
+
+    kit = _pp_kit(config)
+    h = pp_hidden_forward(
+        config, params["transformer"], input_ids, attention_mask,
+        mesh, num_microbatches,
+    )
+    logits = _logits(kit, config, params["transformer"], h)
+    action_hidden = (
+        jnp.take_along_axis(h, actions_ixs[..., None], axis=1)
+        if actions_ixs is not None
+        else h
+    )
+    state_hidden = (
+        jnp.take_along_axis(h, states_ixs[..., None], axis=1)
+        if states_ixs is not None
+        else h
+    )
+    qs, vs = ILQLHeads(config, two_qs).apply(
+        {"params": params["heads"]}, action_hidden, state_hidden
+    )
+    return {
+        "logits": logits,
+        "qs": qs,
+        "vs": vs,
+        "action_hidden": action_hidden,
+    }
+
+
+def pp_slice_logits(config, backbone_params, hidden: jax.Array):
+    """Family LM head on (already-sliced) hidden states — public wrapper
+    for pp callers that slice before the head (`GPT2Model.logits`-class
+    methods; the full [B, T, vocab] tensor is the most expensive
+    intermediate)."""
+    return _logits(_pp_kit(config), config, backbone_params, hidden)
+
+
+def pp_decode_kit(config, mesh: Mesh):
+    """The pp decode wiring both trainers share: ``(init_cache_fn,
+    cache_sharding)`` for ``make_sampler`` — layer-major stage-resident
+    buffers sharded ``P(pp, batch)``. One definition so a layout change
+    cannot silently diverge the PPO and ILQL rollout paths."""
+    import functools
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+
+    return (
+        functools.partial(pp_init_cache, config),
+        NamedSharding(mesh, PartitionSpec("pp", BATCH_AXES)),
+    )
+
+
 # --------------------------- pp rollout decode --------------------------- #
 #
 # Decode under a pp mesh does not replicate the full model per device. The
@@ -345,11 +414,8 @@ def pp_stack_sampler_params(config, mesh: Mesh, params):
         ),
         stacked,
     )
-    return {
-        "transformer": params["transformer"],
-        "v_head": params["v_head"],
-        "stacked_blocks": stacked,
-    }
+    # pass every head tree through untouched (PPO: v_head; ILQL: heads)
+    return {**params, "stacked_blocks": stacked}
 
 
 def pp_cached_hidden(

@@ -75,13 +75,8 @@ class ILQLTrainer(BaseRLTrainer):
         train = config.train
 
         self.mesh = make_mesh(train.mesh)
-        if dict(self.mesh.shape).get("pp", 1) > 1:
-            # without this guard a pp axis would silently replicate all
-            # compute across the pp devices (rules never reference pp)
-            raise NotImplementedError(
-                "pp mesh axis is integrated for the PPO GPT-2 path only; "
-                "ILQL supports dp/fsdp/tp"
-            )
+        self.pp_stages = dict(self.mesh.shape).get("pp", 1)
+        self.pp_microbatches = train.pp_microbatches
         self.rng = set_seed(train.seed)
 
         if tokenizer is None and config.model.tokenizer_path:
@@ -96,6 +91,19 @@ class ILQLTrainer(BaseRLTrainer):
         from trlx_tpu.trainer.ppo_trainer import get_causal_arch
 
         self.family, self.model_config, init_params = get_causal_arch(config)
+        if self.pp_stages > 1:
+            from trlx_tpu.models.pp_runner import supports_pp
+
+            if not supports_pp(self.model_config):
+                # without this guard a pp axis would silently replicate all
+                # compute across the pp devices (rules never reference pp)
+                raise NotImplementedError(
+                    f"pp mesh axis is integrated for the causal families "
+                    f"(gpt2/gptj/gpt_neo/gpt_neox) but not "
+                    f"{type(self.model_config).__name__}: MoE layers have "
+                    f"non-uniform per-layer params (no stage stacking); "
+                    f"use dp/fsdp/tp/ep instead"
+                )
         self.model = CausalLMWithILQLHeads(
             self.model_config,
             two_qs=method.two_qs,
@@ -225,7 +233,16 @@ class ILQLTrainer(BaseRLTrainer):
 
         def train_step(state: ILQLTrainState, mb: ILQLBatch):
             def loss_fn(params):
-                if moe_family:
+                if self.pp_stages > 1:
+                    from trlx_tpu.models.pp_runner import pp_ilql_forward
+
+                    out = pp_ilql_forward(
+                        self.model_config, params, mb.input_ids,
+                        mb.attention_mask, mb.actions_ixs, mb.states_ixs,
+                        self.mesh, self.pp_microbatches,
+                        two_qs=method.two_qs,
+                    )
+                elif moe_family:
                     out, sown = self.model.apply(
                         {"params": params},
                         mb.input_ids,
@@ -316,43 +333,114 @@ class ILQLTrainer(BaseRLTrainer):
         )
 
         # --- advantage-shifted sampler (`ilql_models.py:257-327`) ---
-        def sample_apply(bundle, input_ids, attention_mask=None, position_ids=None,
-                         cache=None, cache_index=None, last_only=False):
-            # last_only (prefill): logits + Q/V heads only at the final
-            # position — the advantage-shifted decode reads one row.
-            out = self.model.apply(
-                {"params": bundle["params"]},
-                input_ids,
-                attention_mask=attention_mask,
-                position_ids=position_ids,
-                cache=cache,
-                cache_index=cache_index,
-                last_only=last_only,
-            )
-            target_qs = self.model.apply(
-                {"params": {"heads": bundle["target"]}},
-                out["action_hidden"],
-                method=CausalLMWithILQLHeads.target_qs,
-            )
-            minq = target_qs[0]
-            for tq in target_qs[1:]:
+        def shift_logits(raw_logits, qs_tuple, vs, input_ids, last_only):
+            """β(Q−V)-shifted sampling logits + adjacency mask — shared by
+            the plain and pp sampler applies."""
+            minq = qs_tuple[0]
+            for tq in qs_tuple[1:]:
                 minq = jnp.minimum(minq, tq)
-            adv = minq - out["vs"][..., None]
-            logits = jax.nn.log_softmax(out["logits"], axis=-1) + self.beta * adv
+            adv = minq - vs[..., None]
+            logits = jax.nn.log_softmax(raw_logits, axis=-1) + self.beta * adv
             if logit_mask is not None:
                 ids = input_ids[:, -1:] if last_only else input_ids
                 allowed = logit_mask[ids]  # [B, T or 1, V] bool
                 logits = jnp.where(allowed, logits, -1e9)
-            return {"logits": logits, "cache": out["cache"]}
+            return logits
 
-        sampler = make_sampler(
-            sample_apply,
-            functools.partial(self.family.init_cache, self.model_config),
-            self.gen_config,
-            self.query_length,
-            with_values=False,
-            cache_sharding=self._decode_cache_sharding(),
-        )
+        if self.pp_stages > 1:
+            # pp decode: trunk pipelined with stage-resident KV buffers;
+            # logits + Q/V/target-Q heads replicated over pp at the last
+            # position only (all the advantage-shifted decode reads)
+            from trlx_tpu.models.heads import ILQLHeads
+            from trlx_tpu.models.pp_runner import (
+                pp_cached_hidden,
+                pp_decode_kit,
+                pp_slice_logits,
+                pp_stack_sampler_params,
+            )
+
+            heads_mod = ILQLHeads(self.model_config, method.two_qs)
+
+            def sample_apply(bundle, input_ids, attention_mask=None,
+                             position_ids=None, cache=None, cache_index=None,
+                             last_only=False):
+                params = bundle["params"]
+                h, new_cache = pp_cached_hidden(
+                    self.model_config, params["transformer"], input_ids,
+                    attention_mask, position_ids, cache, cache_index,
+                    self.mesh, self.pp_microbatches,
+                    stacked=params["stacked_blocks"],
+                )
+                hs = h[:, -1:]
+                raw = pp_slice_logits(
+                    self.model_config, params["transformer"], hs
+                )
+                # only V from the live heads; the advantage shift reads
+                # target-Q (live Q heads would trace dead matmuls)
+                vs = heads_mod.apply(
+                    {"params": params["heads"]}, hs, method=ILQLHeads.v
+                )
+                target_qs = heads_mod.apply(
+                    {"params": bundle["target"]}, hs, method=ILQLHeads.q
+                )
+                logits = shift_logits(raw, target_qs, vs, input_ids, True)
+                return {"logits": logits, "cache": new_cache}
+
+            init_cache_fn, cache_sharding = pp_decode_kit(
+                self.model_config, self.mesh
+            )
+            inner = make_sampler(
+                sample_apply,
+                init_cache_fn,
+                self.gen_config,
+                self.query_length,
+                with_values=False,
+                cache_sharding=cache_sharding,
+            )
+
+            def sampler(bundle, prompt_ids, prompt_mask, rng):
+                # stack/reshard the trunk blocks ONCE per invocation, not
+                # once per decoded token inside the sampler's scan
+                packed = pp_stack_sampler_params(
+                    self.model_config, self.mesh, bundle["params"]
+                )
+                return inner(
+                    {"params": packed, "target": bundle["target"]},
+                    prompt_ids, prompt_mask, rng,
+                )
+        else:
+            def sample_apply(bundle, input_ids, attention_mask=None,
+                             position_ids=None, cache=None, cache_index=None,
+                             last_only=False):
+                # last_only (prefill): logits + Q/V heads only at the final
+                # position — the advantage-shifted decode reads one row.
+                out = self.model.apply(
+                    {"params": bundle["params"]},
+                    input_ids,
+                    attention_mask=attention_mask,
+                    position_ids=position_ids,
+                    cache=cache,
+                    cache_index=cache_index,
+                    last_only=last_only,
+                )
+                target_qs = self.model.apply(
+                    {"params": {"heads": bundle["target"]}},
+                    out["action_hidden"],
+                    method=CausalLMWithILQLHeads.target_qs,
+                )
+                logits = shift_logits(
+                    out["logits"], target_qs, out["vs"], input_ids, last_only
+                )
+                return {"logits": logits, "cache": out["cache"]}
+
+            sampler = make_sampler(
+                sample_apply,
+                functools.partial(self.family.init_cache, self.model_config),
+                self.gen_config,
+                self.query_length,
+                with_values=False,
+                cache_sharding=self._decode_cache_sharding(),
+            )
         bundle_shardings = {
             "params": self.param_shardings,
             "target": self.target_shardings,

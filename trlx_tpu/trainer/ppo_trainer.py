@@ -440,20 +440,22 @@ class PPOTrainer(BaseRLTrainer):
         if self.pp_stages > 1:
             from trlx_tpu.models.pp_runner import (
                 make_pp_sampler_apply,
-                pp_init_cache,
+                pp_decode_kit,
                 pp_stack_sampler_params,
             )
-            from trlx_tpu.parallel.mesh import BATCH_AXES
 
+            init_cache_fn, cache_sharding = pp_decode_kit(
+                self.model_config, self.mesh
+            )
             inner = make_sampler(
                 make_pp_sampler_apply(
                     self.model_config, self.mesh, self.pp_microbatches
                 ),
-                functools.partial(pp_init_cache, self.model_config),
+                init_cache_fn,
                 self.gen_config,
                 self.query_length,
                 with_values=True,
-                cache_sharding=NamedSharding(self.mesh, P("pp", BATCH_AXES)),
+                cache_sharding=cache_sharding,
             )
 
             def sampler(params, prompt_ids, prompt_mask, rng):
