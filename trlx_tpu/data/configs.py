@@ -124,11 +124,14 @@ class TrainConfig:
     param_dtype: str = "float32"
     # Serve the rollout phase (sampler + frozen-ref scoring) a one-time
     # compute-dtype copy of the master params instead of the f32 masters.
-    # Decode is HBM-bound and re-reads every parameter once per generated
-    # token, so when param_dtype=f32 and dtype=bf16 this halves decode
-    # weight traffic. Bit-identical outputs: every op already casts params
-    # to the compute dtype per use; leaves that genuinely compute in f32
-    # (value-head fc2, MoE router logits) are excluded from the cast.
+    # Bit-identical outputs: every op already casts params to the compute
+    # dtype per use; leaves that genuinely compute in f32 (value-head fc2,
+    # MoE router logits) are excluded. Measured ~neutral on the single-chip
+    # bench (ab_rollout_cast.py: sampler 1.02x, ref scoring 0.92x — XLA
+    # hoists the loop-invariant f32->bf16 weight conversion out of the
+    # decode scan, so per-token reads were already bf16); kept default-on
+    # for the halved frozen-ref HBM residency and because on an fsdp mesh
+    # the compute-dtype copy halves rollout param all-gather volume.
     # Causal families only — the seq2seq trainer keeps f32 (T5's RMSNorm
     # scales / relative bias are consumed at f32).
     rollout_param_cast: bool = True
