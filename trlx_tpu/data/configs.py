@@ -120,6 +120,13 @@ class TrainConfig:
     # GPipe microbatches per batch shard when the mesh has a pp axis > 1
     # (must divide batch_size / (dp * fsdp)); see models/pp_runner.py
     pp_microbatches: int = 2
+    # Interleaved virtual stages per pp device for the TRAIN schedule
+    # (Megatron-style): each device holds v round-robin layer chunks, the
+    # fill/drain bubble shrinks ~v x at the cost of v x more ppermute hops.
+    # Requires pp_microbatches <= pp and n_layer % (pp * v) == 0; decode
+    # keeps the plain stage-major schedule (the stage-resident KV layout
+    # is contiguous). See parallel/pipeline.py::pipeline_span_layer_units.
+    pp_virtual_stages: int = 1
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     # Serve the rollout phase (sampler + frozen-ref scoring) a one-time

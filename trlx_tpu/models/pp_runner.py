@@ -85,24 +85,31 @@ def supports_pp(model_config) -> bool:
     return _pp_kit(model_config) is not None
 
 
-def _stack_stages(block_params, stages: int):
-    """[L] per-block param trees -> leaves [S, L/S, ...] (stage-major)."""
-    per = len(block_params) // stages
-    stage_trees = [
+def _stack_stages(block_params, stages: int, virtual: int = 1):
+    """[L] per-block param trees -> leaves [S, L/S, ...] (stage-major), or
+    [S, v, L/(S·v), ...] when ``virtual > 1`` (interleaved: chunk
+    c = lap·S + d on device d — round-robin layer placement)."""
+    groups = stages * virtual
+    per = len(block_params) // groups
+    group_trees = [
         jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs, axis=0),
-            *block_params[s * per : (s + 1) * per],
+            *block_params[g * per : (g + 1) * per],
         )
-        for s in range(stages)
+        for g in range(groups)
     ]
-    return stack_stage_params(stage_trees)
+    if virtual > 1:
+        from trlx_tpu.parallel.pipeline import stack_stage_params_interleaved
+
+        return stack_stage_params_interleaved(group_trees, stages, virtual)
+    return stack_stage_params(group_trees)
 
 
-def _local_flags(config, stages: int) -> Optional[jax.Array]:
-    """gpt_neo per-layer local-attention flags, stage-stacked [S, L/S]."""
+def _local_flags(config, stages: int, virtual: int = 1) -> Optional[jax.Array]:
+    """gpt_neo per-layer local-attention flags, stage-stacked like params."""
     types = config.layer_types
     flags = [jnp.asarray(t == "local") for t in types]
-    return _stack_stages(flags, stages)
+    return _stack_stages(flags, stages, virtual)
 
 
 def _embed(kit: _PPKit, config, backbone_params, input_ids, position_ids):
@@ -177,22 +184,28 @@ def pp_hidden_forward(
     attention_mask: jax.Array,  # [B, T]
     mesh: Mesh,
     num_microbatches: int = 2,
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Full-sequence causal trunk forward (embed -> pp blocks -> ln_f),
     numerically identical to the family backbone's ``__call__`` with
     ``cache=None``. Embedding / ln_f / heads reuse the flax module methods
     (one definition) — only the block loop is replaced by the pipeline
     schedule. Rotary position_ids and gpt_neo's per-layer band biases ride
-    the schedule's aux tree."""
+    the schedule's aux tree. ``virtual_stages > 1`` runs the interleaved
+    schedule (`train.pp_virtual_stages`): bubble shrinks ~v× at the cost
+    of v× more ppermute hops (`pipeline_span_layer_units`)."""
     kit = _pp_kit(config)
     if kit is None:
         raise NotImplementedError(
             f"pp is not available for {type(config).__name__}"
         )
     S = mesh.shape["pp"]
+    v = virtual_stages
     L = num_layers_of(config)
-    if L % S:
-        raise ValueError(f"n_layer={L} must divide into pp={S} stages")
+    if L % (S * v):
+        raise ValueError(
+            f"n_layer={L} must divide into pp={S} stages x {v} virtual"
+        )
     B, T = input_ids.shape
     position_ids = jnp.clip(jnp.cumsum(attention_mask, axis=-1) - 1, 0, None)
     x = _embed(kit, config, backbone_params, input_ids, position_ids)
@@ -218,9 +231,9 @@ def pp_hidden_forward(
         aux["pos"] = position_ids
 
     stacked = _stack_stages(
-        [backbone_params[f"h_{i}"] for i in range(L)], S
+        [backbone_params[f"h_{i}"] for i in range(L)], S, v
     )
-    flags = _local_flags(config, S) if kit.windowed else None
+    flags = _local_flags(config, S, v) if kit.windowed else None
     block = kit.block_cls(config)
 
     def stage_fn(stage_params, h, aux_mb):
@@ -233,7 +246,7 @@ def pp_hidden_forward(
     stage_tree = (stacked, flags) if kit.windowed else stacked
     h = pipeline_apply(
         stage_fn, stage_tree, x, mesh,
-        num_microbatches=num_microbatches, aux=aux,
+        num_microbatches=num_microbatches, aux=aux, virtual_stages=v,
     )
     return _ln_f(kit, config, backbone_params, h)
 
@@ -246,13 +259,14 @@ def pp_response_forward(
     query_length: int,
     mesh: Mesh,
     num_microbatches: int = 2,
+    virtual_stages: int = 1,
 ):
     """pp counterpart of ``CausalLMWithValueHead.response_forward``:
     (logits, values) over the response-predicting positions Q-1..Q+R-2."""
     kit = _pp_kit(config)
     h = pp_hidden_forward(
         config, params["transformer"], input_ids, attention_mask,
-        mesh, num_microbatches,
+        mesh, num_microbatches, virtual_stages,
     )
     hs = h[:, query_length - 1 : -1]
     v_head = MLPHead(
@@ -271,6 +285,7 @@ def pp_ref_logits(
     query_length: int,
     mesh: Mesh,
     num_microbatches: int = 2,
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Frozen-reference logits over response-predicting positions (the
     full-copy ref path; hydra's shared-trunk branch is not offered under
@@ -278,7 +293,7 @@ def pp_ref_logits(
     kit = _pp_kit(config)
     h = pp_hidden_forward(
         config, backbone_params, input_ids, attention_mask,
-        mesh, num_microbatches,
+        mesh, num_microbatches, virtual_stages,
     )
     return _logits(kit, config, backbone_params, h[:, query_length - 1 : -1])
 
@@ -293,6 +308,7 @@ def pp_ilql_forward(
     mesh: Mesh,
     num_microbatches: int = 2,
     two_qs: bool = True,
+    virtual_stages: int = 1,
 ):
     """pp counterpart of ``CausalLMWithILQLHeads.__call__`` (no cache):
     trunk blocks through the GPipe schedule; logits and the Q/V heads run
@@ -303,7 +319,7 @@ def pp_ilql_forward(
     kit = _pp_kit(config)
     h = pp_hidden_forward(
         config, params["transformer"], input_ids, attention_mask,
-        mesh, num_microbatches,
+        mesh, num_microbatches, virtual_stages,
     )
     logits = _logits(kit, config, params["transformer"], h)
     action_hidden = (

@@ -5,9 +5,9 @@ for parity; optional") — provided as a first-class mesh primitive so deep
 models can shard *layers* over a ``pp`` axis when tensor parallelism alone
 runs out of headroom. TPU-native design: every pp device runs the same
 compiled program inside ``shard_map``; activations hop to the next stage via
-``ppermute`` over ICI each tick, and the classic GPipe schedule (S + M - 1
-ticks for S stages x M microbatches) is a ``lax.fori_loop`` with masked
-writes — no host control flow.
+``ppermute`` over ICI each tick, and the schedule (GPipe: S + M - 1 ticks
+for S stages x M microbatches; interleaved: v·S + M - 1 cheaper ticks) is a
+``lax.fori_loop`` with masked writes — no host control flow.
 
 The primitive is deliberately model-agnostic: ``stage_fn(stage_params, h)
 -> h`` with shape-preserving activations, stage params stacked on a leading
@@ -33,6 +33,31 @@ def stack_stage_params(params_list):
     )
 
 
+def stack_stage_params_interleaved(chunk_trees, stages: int, virtual: int):
+    """[v*S] per-chunk param trees -> leaves [S, v, ...]: chunk
+    ``c = lap*S + d`` goes to device d, lap ``lap`` (round-robin layer
+    placement for the interleaved schedule)."""
+    device_trees = []
+    for d in range(stages):
+        laps = [chunk_trees[lap * stages + d] for lap in range(virtual)]
+        device_trees.append(
+            jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *laps)
+        )
+    return stack_stage_params(device_trees)
+
+
+def pipeline_span_layer_units(S: int, M: int, L: int, v: int = 1) -> int:
+    """Schedule span in single-layer compute units (layer cost = 1).
+
+    GPipe (v=1): ``(S + M - 1)`` ticks of ``L/S`` layers. Interleaved
+    (v>1): ``(v*S + M - 1)`` ticks of ``L/(v*S)`` layers — the fill/drain
+    bubble shrinks by ~v because each tick is v× cheaper while the steady
+    term stays M*L/S. Per-device efficiency: ``M / (S + (M-1)/v)`` vs
+    GPipe's ``M / (S + M - 1)``."""
+    chunk = L // (S * v)
+    return (v * S + M - 1) * chunk
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stacked_params,
@@ -42,6 +67,7 @@ def pipeline_apply(
     num_microbatches: int = 2,
     batch_axes=("dp", "fsdp"),
     aux=None,
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Run ``x`` through S pipeline stages with M microbatches.
 
@@ -56,10 +82,19 @@ def pipeline_apply(
     the microbatch slice matching the activations it is processing, as a
     third argument: ``stage_fn(params, h, aux_mb)``. Unlike ``h``, aux does
     not travel over the wire (every device holds its batch shard).
+
+    ``virtual_stages=v > 1`` runs the interleaved schedule: stacked_params
+    leaves are [S, v, L/(S·v)-chunk, ...] (chunk c = ℓ·S + d lives on
+    device d, lap ℓ — `stack_stage_params_interleaved`) and the span drops
+    from ``(S+M-1)`` ticks of L/S layers to ``(v·S+M-1)`` ticks of
+    L/(v·S) layers (:func:`pipeline_span_layer_units`). Differentiable
+    like the GPipe path (the backward is the mirrored schedule). Requires
+    ``M <= S`` and is train-only (no cache support).
     """
     # One schedule implementation: the cache-less path is the cached path
-    # with an empty cache pytree (round-3 review: two hand-synced copies of
-    # the GPipe tick invite silent divergence).
+    # with an empty cache pytree, and the interleaved schedule is the same
+    # tick with lap-indexed chunk params (round-3 reviews: hand-synced
+    # copies of the pipeline tick invite silent divergence).
     if aux is None:
         def adapted(p, h, _aux, _cache, _idx):
             return stage_fn(p, h), {}
@@ -70,7 +105,7 @@ def pipeline_apply(
     out, _ = pipeline_apply_cached(
         adapted, stacked_params, x, {}, 0, mesh,
         axis_name=axis_name, num_microbatches=num_microbatches,
-        batch_axes=batch_axes, aux=aux,
+        batch_axes=batch_axes, aux=aux, virtual_stages=virtual_stages,
     )
     return out
 
@@ -86,33 +121,66 @@ def pipeline_apply_cached(
     num_microbatches: int = 2,
     batch_axes=("dp", "fsdp"),
     aux=None,
+    virtual_stages: int = 1,
 ):
-    """GPipe schedule with STAGE-RESIDENT KV caches: the rollout-decode
-    counterpart of :func:`pipeline_apply`.
+    """The pipeline schedule — one implementation for all three uses:
+    cache-less train forward (via :func:`pipeline_apply`), rollout decode
+    with STAGE-RESIDENT KV caches, and the interleaved train schedule
+    (``virtual_stages > 1``, cache-less only).
 
     ``cache`` leaves are layer-major ``[L, B, C, ...]`` sharded ``P(pp,
     batch_axes)`` — each device permanently holds the KV buffers of its own
     stage's ``L/S`` layers (plus its dp/fsdp batch shard), so a pp mesh
     shards rollout *memory and compute* instead of replicating the full
-    model per device (the pre-round-3 behavior). Each tick, the active
-    stage reads/writes only the microbatch rows it is processing; writes at
-    inactive (bubble) ticks are masked back to the old values.
+    model per device. Each tick, the active stage reads/writes only the
+    microbatch rows it is processing; writes at inactive (bubble) ticks are
+    masked back to the old values.
 
     ``stage_fn(stage_params, h, aux_mb, stage_cache_mb, cache_index) ->
     (h, new_stage_cache_mb)`` where ``stage_cache_mb`` leaves are
     ``[L/S, b_mb, C, ...]``.
 
+    Interleaved tick math (v > 1): microbatch m enters chunk 0 at tick m
+    and advances one chunk per tick, so chunk c of m runs at tick m + c on
+    device c mod S. With M <= S each device sees at most one live (m, c)
+    per tick (m ≡ t - d (mod S) has one solution in [0, M)), every
+    activation is consumed the tick after it arrives, and the single ring
+    wire buffer suffices; the lap (= c // S) selects which of the device's
+    v param chunks runs. The v = 1 indexing (m = t - idx, no mod) also
+    covers M > S, which the mod form cannot — hence the branch.
+
     Returns ``(out, new_cache)`` with the same shardings as ``(x, cache)``.
     """
     S = mesh.shape[axis_name]
     M = num_microbatches
-    for leaf in jax.tree_util.tree_leaves(stacked_params):
-        if leaf.shape[0] != S:
+    v = virtual_stages
+    if v > 1:
+        if M > S:
             raise ValueError(
-                f"stacked stage params have leading dim {leaf.shape[0]} but "
-                f"the {axis_name!r} axis has {S} devices (one stage per "
-                f"device); extra stages would be silently dropped"
+                f"interleaved schedule requires num_microbatches <= pp "
+                f"stages ({M} > {S}): with M > S two microbatches collide "
+                f"on one device in the same tick; drop virtual_stages or "
+                f"microbatches"
             )
+        if jax.tree_util.tree_leaves(cache):
+            raise NotImplementedError(
+                "interleaved schedule is train-only: the stage-resident "
+                "KV cache layout is contiguous stage-major (v=1)"
+            )
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            if leaf.shape[0] != S or leaf.shape[1] != v:
+                raise ValueError(
+                    f"interleaved stage params must be [S={S}, v={v}, ...]; "
+                    f"got leaf {leaf.shape}"
+                )
+    else:
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            if leaf.shape[0] != S:
+                raise ValueError(
+                    f"stacked stage params have leading dim {leaf.shape[0]} "
+                    f"but the {axis_name!r} axis has {S} devices (one stage "
+                    f"per device); extra stages would be silently dropped"
+                )
     for leaf in jax.tree_util.tree_leaves(cache):
         if leaf.shape[0] % S:
             raise ValueError(
@@ -144,29 +212,52 @@ def pipeline_apply_cached(
 
         def tick(t, carry):
             buf, outs, cache = carry
-            m = t - idx
-            active = jnp.logical_and(m >= 0, m < M)
+            if v > 1:
+                m = (t - idx) % n
+                c = t - m  # chunk index; c ≡ idx (mod n) by construction
+                lap = jnp.clip(c // n, 0, v - 1)
+                active = jnp.logical_and(
+                    m < M, jnp.logical_and(c >= 0, c < v * n)
+                )
+                is_first = c == 0
+                is_last = c == v * n - 1
+                chunk_params = jax.tree_util.tree_map(
+                    lambda p: jax.lax.dynamic_index_in_dim(
+                        p, lap, axis=0, keepdims=False
+                    ),
+                    params,
+                )
+            else:
+                m = t - idx
+                active = jnp.logical_and(m >= 0, m < M)
+                is_first = idx == 0
+                is_last = idx == n - 1
+                chunk_params = params
             m_c = jnp.clip(m, 0, M - 1)
-            h_in = jnp.where(idx == 0, mbs[m_c], buf)
+            h_in = jnp.where(is_first, mbs[m_c], buf)
             aux_m = jax.tree_util.tree_map(lambda a: a[m_c], aux_mbs)
             old_mb = jax.tree_util.tree_map(
-                lambda c: jax.lax.dynamic_slice_in_dim(c, m_c * bm, bm, axis=1),
+                lambda c_: jax.lax.dynamic_slice_in_dim(
+                    c_, m_c * bm, bm, axis=1
+                ),
                 cache,
             )
-            h_out, new_mb = stage_fn(params, h_in, aux_m, old_mb, cache_index)
+            h_out, new_mb = stage_fn(
+                chunk_params, h_in, aux_m, old_mb, cache_index
+            )
             # bubble ticks compute on garbage: mask their cache writes
             new_mb = jax.tree_util.tree_map(
                 lambda nk, ok: jnp.where(active, nk.astype(ok.dtype), ok),
                 new_mb, old_mb,
             )
             cache = jax.tree_util.tree_map(
-                lambda c, nk: jax.lax.dynamic_update_slice_in_dim(
-                    c, nk, m_c * bm, axis=1
+                lambda c_, nk: jax.lax.dynamic_update_slice_in_dim(
+                    c_, nk, m_c * bm, axis=1
                 ),
                 cache, new_mb,
             )
             outs = jnp.where(
-                jnp.logical_and(idx == n - 1, active),
+                jnp.logical_and(active, is_last),
                 outs.at[m_c].set(h_out),
                 outs,
             )
@@ -175,7 +266,7 @@ def pipeline_apply_cached(
             return buf, outs, cache
 
         _, outs, cache = jax.lax.fori_loop(
-            0, S + M - 1, tick, (buf0, outs0, cache)
+            0, v * S + M - 1, tick, (buf0, outs0, cache)
         )
         outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
         outs = jax.lax.psum(outs, axis_name)

@@ -77,6 +77,7 @@ class ILQLTrainer(BaseRLTrainer):
         self.mesh = make_mesh(train.mesh)
         self.pp_stages = dict(self.mesh.shape).get("pp", 1)
         self.pp_microbatches = train.pp_microbatches
+        self.pp_virtual_stages = train.pp_virtual_stages
         self.rng = set_seed(train.seed)
 
         if tokenizer is None and config.model.tokenizer_path:
@@ -241,6 +242,7 @@ class ILQLTrainer(BaseRLTrainer):
                         mb.attention_mask, mb.actions_ixs, mb.states_ixs,
                         self.mesh, self.pp_microbatches,
                         two_qs=method.two_qs,
+                        virtual_stages=self.pp_virtual_stages,
                     )
                 elif moe_family:
                     out, sown = self.model.apply(

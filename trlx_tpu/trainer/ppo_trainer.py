@@ -168,6 +168,7 @@ class PPOTrainer(BaseRLTrainer):
         # plain GSPMD, replicated over pp.
         self.pp_stages = dict(self.mesh.shape).get("pp", 1)
         self.pp_microbatches = train.pp_microbatches
+        self.pp_virtual_stages = train.pp_virtual_stages
         if self.pp_stages > 1:
             from trlx_tpu.models.pp_runner import supports_pp
 
@@ -510,6 +511,7 @@ class PPOTrainer(BaseRLTrainer):
             logits, values = pp_response_forward(
                 self.model_config, params, full_ids, full_mask, Q,
                 self.mesh, self.pp_microbatches,
+                virtual_stages=self.pp_virtual_stages,
             )
         elif self._moe_family:
             from trlx_tpu.models.gpt2_moe import moe_loss_summary
@@ -549,6 +551,7 @@ class PPOTrainer(BaseRLTrainer):
             logits = pp_ref_logits(
                 self.model_config, ref_params, full_ids, full_mask, Q,
                 self.mesh, self.pp_microbatches,
+                virtual_stages=self.pp_virtual_stages,
             )
             return logprobs_from_logits(logits, r_ids)
         if self.use_hydra:
