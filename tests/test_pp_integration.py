@@ -198,13 +198,14 @@ def test_e2e_ppo_trains_on_dp_fsdp_pp_mesh(virtual):
     assert late > early + 0.15, (early, late, means)
 
 
-@pytest.mark.parametrize("model_type", ["gpt2", "gptj"])
+@pytest.mark.parametrize("model_type", list(FAMILY_ARCHS))
 def test_pp_interleaved_schedule_matches_and_shrinks_bubble(model_type):
     """Round-3: `train.pp_virtual_stages` runs the interleaved schedule —
     each pp device holds v round-robin layer chunks, fill/drain bubble
     shrinks ~v× (span (v·S+M-1) ticks of L/(vS) layers vs (S+M-1) of L/S).
-    Exact forward+grad parity vs the plain GSPMD path, and the span math
-    shows the bubble shrink at pp=2."""
+    Exact forward+grad parity vs the plain GSPMD path for EVERY causal
+    family (incl. gpt_neo's round-robin local-flag placement and both
+    rotary families), and the span math shows the bubble shrink at pp=2."""
     import jax
     import jax.flatten_util
     import jax.numpy as jnp
