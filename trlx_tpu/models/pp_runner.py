@@ -185,6 +185,8 @@ def pp_hidden_forward(
     mesh: Mesh,
     num_microbatches: int = 2,
     virtual_stages: int = 1,
+    capture_layer: int = None,
+    capture_only: bool = False,
 ) -> jax.Array:
     """Full-sequence causal trunk forward (embed -> pp blocks -> ln_f),
     numerically identical to the family backbone's ``__call__`` with
@@ -193,7 +195,11 @@ def pp_hidden_forward(
     schedule. Rotary position_ids and gpt_neo's per-layer band biases ride
     the schedule's aux tree. ``virtual_stages > 1`` runs the interleaved
     schedule (`train.pp_virtual_stages`): bubble shrinks ~v× at the cost
-    of v× more ppermute hops (`pipeline_span_layer_units`)."""
+    of v× more ppermute hops (`pipeline_span_layer_units`).
+    ``capture_layer=k`` (v=1, k on a stage boundary) additionally returns
+    the activation entering block k — the hydra branch point (the non-pp
+    backbones' ``capture_hidden_at``); the return becomes
+    ``(h_after_ln_f, captured)``."""
     kit = _pp_kit(config)
     if kit is None:
         raise NotImplementedError(
@@ -243,12 +249,31 @@ def pp_hidden_forward(
         h, _ = jax.lax.scan(body, h, xs)
         return h
 
+    capture_stage = None
+    if capture_layer is not None:
+        chunk = L // S
+        if capture_layer % chunk:
+            raise NotImplementedError(
+                f"hydra branch point at layer {capture_layer} does not sit "
+                f"on a stage boundary (stage size {chunk}); choose "
+                f"num_layers_unfrozen so L - unfrozen is a multiple of L/pp"
+            )
+        capture_stage = capture_layer // chunk
+
     stage_tree = (stacked, flags) if kit.windowed else stacked
-    h = pipeline_apply(
+    res = pipeline_apply(
         stage_fn, stage_tree, x, mesh,
         num_microbatches=num_microbatches, aux=aux, virtual_stages=v,
+        capture_stage=capture_stage, capture_only=capture_only,
     )
-    return _ln_f(kit, config, backbone_params, h)
+    if capture_stage is None:
+        return _ln_f(kit, config, backbone_params, res)
+    h, caps = res
+    if capture_only:
+        # the schedule stopped at the capture; h never finished (stages
+        # >= k did not run) — return only the branch activation
+        return None, caps
+    return _ln_f(kit, config, backbone_params, h), caps
 
 
 def pp_response_forward(
@@ -288,14 +313,63 @@ def pp_ref_logits(
     virtual_stages: int = 1,
 ) -> jax.Array:
     """Frozen-reference logits over response-predicting positions (the
-    full-copy ref path; hydra's shared-trunk branch is not offered under
-    pp — the trunk capture point sits mid-pipeline)."""
+    full-copy ref path; the hydra shared-trunk variant is
+    :func:`pp_hydra_ref_logits`)."""
     kit = _pp_kit(config)
     h = pp_hidden_forward(
         config, backbone_params, input_ids, attention_mask,
         mesh, num_microbatches, virtual_stages,
     )
     return _logits(kit, config, backbone_params, h[:, query_length - 1 : -1])
+
+
+def pp_hydra_ref_logits(
+    config,
+    policy_backbone_params,
+    ref_params,  # hydra subset: top blocks + ln_f + head tables
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    query_length: int,
+    branch_start: int,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+) -> jax.Array:
+    """Hydra shared-trunk KL reference under pp (`ppo_models.py:505-558`).
+
+    The frozen trunk activation at the branch point is captured from the
+    policy trunk's OWN pipeline schedule (the input of the stage owning
+    block ``branch_start`` — a stage boundary, enforced by
+    ``pp_hidden_forward``), then the small frozen branch (the top
+    ``L - branch_start`` blocks + ln_f + LM head from ``ref_params``) runs
+    replicated over pp — exactly the non-pp hydra semantics
+    (``capture_hidden_at`` + ``start_layer``/``hidden_override``), with
+    the branch too small to be worth pipelining."""
+    kit = _pp_kit(config)
+    L = num_layers_of(config)
+    # capture_only: the schedule stops once the last microbatch reaches
+    # the branch stage — the frozen top stages are not re-run for a result
+    # nobody reads (they'd cost more than the full-copy ref otherwise)
+    _, x = pp_hidden_forward(
+        config, policy_backbone_params, input_ids, attention_mask,
+        mesh, num_microbatches, capture_layer=branch_start,
+        capture_only=True,
+    )
+    position_ids = jnp.clip(jnp.cumsum(attention_mask, axis=-1) - 1, 0, None)
+    pad = padding_bias(attention_mask)
+    block = kit.block_cls(config)
+    types = config.layer_types if kit.windowed else None
+    T = input_ids.shape[1]
+    for i in range(branch_start, L):
+        if kit.windowed and types[i] == "local":
+            bias, causal = _neo_local_bias(config, T, T, 0, pad), False
+        else:
+            bias, causal = pad, True
+        args = (x, bias) + ((position_ids,) if kit.takes_positions else ())
+        x, _ = block.apply(
+            {"params": ref_params[f"h_{i}"]}, *args, causal=causal
+        )
+    x = _ln_f(kit, config, ref_params, x)
+    return _logits(kit, config, ref_params, x[:, query_length - 1 : -1])
 
 
 def pp_ilql_forward(

@@ -68,6 +68,8 @@ def pipeline_apply(
     batch_axes=("dp", "fsdp"),
     aux=None,
     virtual_stages: int = 1,
+    capture_stage: int = None,
+    capture_only: bool = False,
 ) -> jax.Array:
     """Run ``x`` through S pipeline stages with M microbatches.
 
@@ -102,12 +104,15 @@ def pipeline_apply(
         def adapted(p, h, aux_m, _cache, _idx):
             return stage_fn(p, h, aux_m), {}
 
-    out, _ = pipeline_apply_cached(
+    res = pipeline_apply_cached(
         adapted, stacked_params, x, {}, 0, mesh,
         axis_name=axis_name, num_microbatches=num_microbatches,
         batch_axes=batch_axes, aux=aux, virtual_stages=virtual_stages,
+        capture_stage=capture_stage, capture_only=capture_only,
     )
-    return out
+    if capture_stage is None:
+        return res[0]
+    return res[0], res[2]  # (out — INVALID if capture_only, capture)
 
 
 def pipeline_apply_cached(
@@ -122,11 +127,21 @@ def pipeline_apply_cached(
     batch_axes=("dp", "fsdp"),
     aux=None,
     virtual_stages: int = 1,
+    capture_stage: int = None,
+    capture_only: bool = False,
 ):
     """The pipeline schedule — one implementation for all three uses:
     cache-less train forward (via :func:`pipeline_apply`), rollout decode
     with STAGE-RESIDENT KV caches, and the interleaved train schedule
     (``virtual_stages > 1``, cache-less only).
+
+    ``capture_stage=k`` additionally collects the activation ENTERING stage
+    k for every microbatch (the hydra shared-trunk branch point — the
+    boundary between stage k-1 and k) and returns it as a third output
+    ``[B, ...]`` shaped like ``x``. v=1 only. With ``capture_only=True``
+    the schedule stops after tick ``k + M - 1`` (the last microbatch's
+    arrival at stage k) — the first output is then INVALID (stages >= k
+    never ran to completion); callers take only the capture.
 
     ``cache`` leaves are layer-major ``[L, B, C, ...]`` sharded ``P(pp,
     batch_axes)`` — each device permanently holds the KV buffers of its own
@@ -154,6 +169,17 @@ def pipeline_apply_cached(
     S = mesh.shape[axis_name]
     M = num_microbatches
     v = virtual_stages
+    if capture_stage is not None:
+        if v > 1:
+            raise NotImplementedError(
+                "capture_stage (hydra branch point) is not available on "
+                "the interleaved schedule: the stage boundary is not a "
+                "single device's input there"
+            )
+        if not (0 <= capture_stage < S):
+            raise ValueError(
+                f"capture_stage={capture_stage} outside [0, {S})"
+            )
     if v > 1:
         if M > S:
             raise ValueError(
@@ -211,7 +237,13 @@ def pipeline_apply_cached(
         outs0 = jnp.zeros_like(mbs) + pp_zero
 
         def tick(t, carry):
-            buf, outs, cache = carry
+            # caps rides the carry only when a capture is requested — the
+            # hot paths (train forward, per-token decode) carry no dead
+            # buffer
+            if capture_stage is not None:
+                buf, outs, cache, caps = carry
+            else:
+                (buf, outs, cache), caps = carry, None
             if v > 1:
                 m = (t - idx) % n
                 c = t - m  # chunk index; c ≡ idx (mod n) by construction
@@ -235,6 +267,13 @@ def pipeline_apply_cached(
                 chunk_params = params
             m_c = jnp.clip(m, 0, M - 1)
             h_in = jnp.where(is_first, mbs[m_c], buf)
+            if capture_stage is not None:
+                # the activation ENTERING stage k (the hydra branch point)
+                caps = jnp.where(
+                    jnp.logical_and(active, idx == capture_stage),
+                    caps.at[m_c].set(h_in),
+                    caps,
+                )
             aux_m = jax.tree_util.tree_map(lambda a: a[m_c], aux_mbs)
             old_mb = jax.tree_util.tree_map(
                 lambda c_: jax.lax.dynamic_slice_in_dim(
@@ -263,14 +302,30 @@ def pipeline_apply_cached(
             )
             wire = jnp.where(active, h_out, buf * 0.0)
             buf = jax.lax.ppermute(wire, axis_name, perm)
-            return buf, outs, cache
+            if capture_stage is None:
+                return buf, outs, cache
+            return buf, outs, cache, caps
 
-        _, outs, cache = jax.lax.fori_loop(
-            0, v * S + M - 1, tick, (buf0, outs0, cache)
-        )
+        n_ticks = v * S + M - 1
+        if capture_stage is not None and capture_only:
+            # last microbatch reaches stage k at tick k + M - 1
+            n_ticks = capture_stage + M
+        if capture_stage is None:
+            _, outs, cache = jax.lax.fori_loop(
+                0, n_ticks, tick, (buf0, outs0, cache)
+            )
+        else:
+            caps0 = jnp.zeros_like(mbs) + pp_zero
+            _, outs, cache, caps = jax.lax.fori_loop(
+                0, n_ticks, tick, (buf0, outs0, cache, caps0)
+            )
         outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
         outs = jax.lax.psum(outs, axis_name)
-        return outs.reshape(x.shape), cache
+        if capture_stage is None:
+            return outs.reshape(x.shape), cache
+        caps = jnp.where(idx == capture_stage, caps, jnp.zeros_like(caps))
+        caps = jax.lax.psum(caps, axis_name)
+        return outs.reshape(x.shape), cache, caps.reshape(x.shape)
 
     from jax import shard_map
 
@@ -290,9 +345,14 @@ def pipeline_apply_cached(
         lambda _: P(axis_name, batch_axes), cache
     )
     aux_specs = jax.tree_util.tree_map(lambda _: P(batch_axes), aux)
+    out_specs = (
+        (x_spec, cache_specs)
+        if capture_stage is None
+        else (x_spec, cache_specs, x_spec)
+    )
     return shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, x_spec, cache_specs, P(), aux_specs),
-        out_specs=(x_spec, cache_specs),
+        out_specs=out_specs,
     )(stacked_params, x, cache, cache_index, aux)

@@ -180,12 +180,33 @@ class PPOTrainer(BaseRLTrainer):
                     f"non-uniform per-layer params (no stage stacking); "
                     f"use dp/fsdp/tp/sp/ep instead"
                 )
-            if config.model.num_layers_unfrozen > 0:
-                raise NotImplementedError(
-                    "hydra shared-trunk KL reference (num_layers_unfrozen"
-                    " > 0) is not available under pp: the trunk capture "
-                    "point sits mid-pipeline; use the full-copy reference"
+            L = self._n_layers()
+            if L % self.pp_stages:
+                raise ValueError(
+                    f"n_layer={L} must divide into pp={self.pp_stages} "
+                    f"stages"
                 )
+            if config.model.num_layers_unfrozen > 0:
+                # hydra under pp needs the branch point on a stage boundary
+                # (the capture is a stage's input — round 3; previously
+                # refused outright)
+                chunk = L // self.pp_stages
+                branch = L - config.model.num_layers_unfrozen
+                if branch % chunk:
+                    raise NotImplementedError(
+                        f"hydra under pp needs the branch point on a stage "
+                        f"boundary: L={L}, pp={self.pp_stages} gives stage "
+                        f"size {chunk}, but L - num_layers_unfrozen = "
+                        f"{branch}; adjust num_layers_unfrozen or use the "
+                        f"full-copy reference"
+                    )
+                if train.pp_virtual_stages > 1:
+                    raise NotImplementedError(
+                        "hydra under pp runs the v=1 schedule (the branch "
+                        "capture is a single stage's input, which the "
+                        "interleaved schedule does not expose); drop "
+                        "pp_virtual_stages or use the full-copy reference"
+                    )
 
         gen_kwargs = dict(method.gen_kwargs)
         self.apply_tokenizer_gen_defaults(gen_kwargs)
@@ -546,6 +567,15 @@ class PPOTrainer(BaseRLTrainer):
         full_ids = jnp.concatenate([q_ids, r_ids], axis=1)
         full_mask = jnp.concatenate([q_mask, r_mask], axis=1)
         if self.pp_stages > 1:
+            if self.use_hydra:
+                from trlx_tpu.models.pp_runner import pp_hydra_ref_logits
+
+                logits = pp_hydra_ref_logits(
+                    self.model_config, policy_params[self.backbone_key],
+                    ref_params, full_ids, full_mask, Q, self.branch_start,
+                    self.mesh, self.pp_microbatches,
+                )
+                return logprobs_from_logits(logits, r_ids)
             from trlx_tpu.models.pp_runner import pp_ref_logits
 
             logits = pp_ref_logits(
