@@ -423,6 +423,136 @@ def test_hydra_under_pp_matches_plain_hydra():
     assert int(trainer.state.step) >= 2
 
 
+def _t5_config(mesh, **train_overrides):
+    from trlx_tpu.data.configs import TRLConfig
+
+    return TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "t5",
+                "model_arch": {
+                    "vocab_size": 32, "d_model": 32, "d_kv": 8, "d_ff": 64,
+                    "num_layers": 2, "num_decoder_layers": 2, "num_heads": 4,
+                    "relative_attention_num_buckets": 8,
+                    "relative_attention_max_distance": 16,
+                    "feed_forward_proj": "gated-gelu",
+                    "tie_word_embeddings": False,
+                },
+            },
+            "train": {
+                "seq_length": 8,
+                "batch_size": 16,
+                "epochs": 1,
+                "total_steps": 4,
+                "eval_interval": 1000,
+                "checkpoint_interval": 100000,
+                "mesh": mesh,
+                "dtype": "float32",
+                "seed": 7,
+                "trainer": "Seq2SeqPPOTrainer",
+                **train_overrides,
+            },
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 16,
+                "chunk_size": 16,
+                "ppo_epochs": 1,
+                "init_kl_coef": 0.02,
+                "scale_reward": None,
+                "gen_kwargs": {
+                    "max_new_tokens": 5,
+                    "do_sample": True,
+                    "eos_token_id": 1,
+                    "pad_token_id": 0,
+                    "decoder_start_token_id": 0,
+                },
+            },
+        }
+    )
+
+
+def test_seq2seq_pp_forward_matches_and_trains():
+    """Round-3: the seq2seq (T5) PPO path accepts a pp mesh — BOTH trunk
+    stacks pipeline in the update's forwards (`pp_runner.pp_t5_forward`,
+    bias tensors + encoder output on the aux tree). Exact logits/values and
+    gradient parity vs the plain teacher-forced forward, then a full e2e
+    train run on dp×fsdp×pp (sampler stays GSPMD, replicated over pp)."""
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+
+    import trlx_tpu
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    t_pp = get_trainer("Seq2SeqPPOTrainer")(
+        _t5_config({"dp": 2, "fsdp": 2, "tp": 1, "pp": 2}),
+        reward_fn=lambda **kw: [0.0],
+    )
+
+    rng = np.random.default_rng(0)
+    B, S, R = 16, 6, 5
+    q_ids = jnp.asarray(rng.integers(2, 30, (B, S)), jnp.int32)
+    q_mask = jnp.ones((B, S), jnp.int32)
+    dec_ids = jnp.asarray(rng.integers(2, 30, (B, R)), jnp.int32)
+    dec_mask = jnp.ones((B, R), jnp.int32)
+    params = jax.device_get(t_pp.state.params)
+
+    from trlx_tpu.models.pp_runner import pp_t5_response_forward
+
+    def pp_path(p):
+        return pp_t5_response_forward(
+            t_pp.model_config, p, q_ids, q_mask, dec_ids, dec_mask,
+            t_pp.mesh, t_pp.pp_microbatches,
+        )
+
+    def plain_path(p):
+        out = t_pp.model.apply(
+            {"params": p}, q_ids, attention_mask=q_mask,
+            decoder_input_ids=dec_ids, decoder_attention_mask=dec_mask,
+        )
+        return out["logits"], out["values"]
+
+    pp_logits, pp_values = jax.jit(pp_path)(params)
+    pl_logits, pl_values = jax.jit(plain_path)(params)
+    np.testing.assert_allclose(
+        np.asarray(pp_logits), np.asarray(pl_logits), atol=1e-4, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(pp_values), np.asarray(pl_values), atol=1e-4, rtol=1e-4
+    )
+
+    def loss_pp(p):
+        logits, values = pp_path(p)
+        return jnp.mean(logits**2) + jnp.mean(values**2)
+
+    def loss_plain(p):
+        logits, values = plain_path(p)
+        return jnp.mean(logits**2) + jnp.mean(values**2)
+
+    g_pp = jax.jit(jax.grad(loss_pp))(params)
+    g_pl = jax.jit(jax.grad(loss_plain))(params)
+    flat_pp, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_pp))
+    flat_pl, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_pl))
+    np.testing.assert_allclose(
+        np.asarray(flat_pp), np.asarray(flat_pl), atol=1e-4, rtol=1e-3
+    )
+
+    # e2e through the public API on the pp mesh
+    prompts = [list(rng.integers(2, 30, size=6)) for _ in range(16)]
+    trainer = trlx_tpu.train(
+        reward_fn=lambda samples, queries, response_gt=None: [
+            float(len(set(s.split()) & set(q.split())))
+            for s, q in zip(samples, queries)
+        ],
+        prompts=prompts,
+        config=_t5_config({"dp": 2, "fsdp": 2, "tp": 1, "pp": 2}),
+    )
+    assert int(trainer.state.step) >= 1
+    leaves = jax.tree_util.tree_leaves(trainer.state.params)
+    assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
+
+
 def test_pp_rejects_misaligned_hydra_and_moe():
     from trlx_tpu.utils.loading import get_trainer
 

@@ -15,8 +15,10 @@ via the schedule's aux tree; gpt_neo's alternating global/local (sliding
 window) layers select between two explicit biases with a per-layer flag
 scanned alongside the stage params. MoE (`gpt2_moe`) stays excluded — its
 per-layer param structure is non-uniform (router/experts on MoE layers
-only), so stage stacking does not apply; T5 is encoder-decoder and out of
-scope for the causal pipeline.
+only), so stage stacking does not apply. The seq2seq (T5) family has its
+own pipeline section below (``pp_t5_forward``): both trunk stacks run the
+schedule back to back, with the per-stack rel-pos bias and the encoder
+output riding the aux tree.
 
 Scope and composition:
 - Stage s runs blocks ``[s*L/S, (s+1)*L/S)`` with an in-stage ``lax.scan``;
@@ -370,6 +372,168 @@ def pp_hydra_ref_logits(
         )
     x = _ln_f(kit, config, ref_params, x)
     return _logits(kit, config, ref_params, x[:, query_length - 1 : -1])
+
+
+# ------------------------- seq2seq (T5) pipeline ------------------------- #
+
+
+def supports_pp_seq2seq(model_config) -> bool:
+    from trlx_tpu.models.t5 import T5Config
+
+    return isinstance(model_config, T5Config)
+
+
+def pp_t5_forward(
+    config,
+    backbone_params,  # T5Model params ("t5" subtree)
+    input_ids: jax.Array,  # [B, S_enc]
+    attention_mask: jax.Array,  # [B, S_enc]
+    decoder_input_ids: jax.Array,  # [B, T]
+    decoder_attention_mask: jax.Array,  # [B, T]
+    mesh: Mesh,
+    num_microbatches: int = 2,
+):
+    """Teacher-forced enc→dec forward with BOTH stacks' blocks pipelined
+    over pp (two schedules back to back), numerically identical to
+    ``T5Model.__call__`` (`models/t5.py:431-448` — the fork's policy model,
+    `ppo_models.py:607-655`). Embeddings, the learned rel-pos bias tables,
+    final LayerNorms, and the LM head run replicated over pp; each stack's
+    shared bias tensor is computed once outside the schedule and rides the
+    aux tree (batch-leading), so gradient flows to the rel-pos embeddings
+    through aux. The encoder output rides the decoder schedule's aux the
+    same way (every device holds its batch shard)."""
+    from trlx_tpu.models.t5 import T5DecoderBlock, T5EncoderBlock, T5Model
+    from trlx_tpu.ops.attention import NEG_INF
+
+    S = mesh.shape["pp"]
+    L_enc, L_dec = config.num_layers, config.num_decoder_layers
+    if L_enc % S or L_dec % S:
+        raise ValueError(
+            f"num_layers={L_enc} and num_decoder_layers={L_dec} must both "
+            f"divide into pp={S} stages"
+        )
+    backbone = T5Model(config)
+    dtype = jnp.dtype(config.dtype)
+    B, T_enc = input_ids.shape
+
+    def bb(fn, *args):
+        return backbone.apply({"params": backbone_params}, *args, method=fn)
+
+    # --- encoder stack (bias construction mirrors T5Model.encode) ---
+    x = bb(lambda m, i: m.shared(i).astype(dtype), input_ids)
+    pos = jnp.arange(T_enc)
+    enc_bias = bb(lambda m, q, k: m.enc_rel_bias(q, k), pos, pos)
+    if attention_mask is not None:
+        enc_bias = enc_bias + jnp.where(
+            attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
+        )
+    enc_bias = jnp.broadcast_to(
+        enc_bias, (B,) + enc_bias.shape[1:]
+    )
+    enc_stacked = _stack_stages(
+        [backbone_params[f"enc_{i}"] for i in range(L_enc)], S
+    )
+    enc_block = T5EncoderBlock(config)
+
+    def enc_stage(stage_params, h, aux_mb):
+        def body(h, p):
+            return enc_block.apply({"params": p}, h, aux_mb["bias"]), None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    x = pipeline_apply(
+        enc_stage, enc_stacked, x, mesh,
+        num_microbatches=num_microbatches, aux={"bias": enc_bias},
+    )
+    encoder_hidden = bb(lambda m, v_: m.enc_final_ln(v_), x)
+
+    # --- decoder stack (bias construction mirrors T5Model.decode) ---
+    T = decoder_input_ids.shape[1]
+    y = bb(lambda m, i: m.shared(i).astype(dtype), decoder_input_ids)
+    q_pos = jnp.arange(T)
+    k_pos = jnp.arange(T)
+    causal = jnp.where(k_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF)[
+        None, None
+    ]
+    self_bias = bb(lambda m, q, k: m.dec_rel_bias(q, k), q_pos, k_pos) + causal
+    if decoder_attention_mask is not None:
+        self_bias = self_bias + jnp.where(
+            decoder_attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
+        )
+    self_bias = jnp.broadcast_to(self_bias, (B,) + self_bias.shape[1:])
+    if attention_mask is not None:
+        cross_bias = jnp.where(
+            attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
+        ).astype(jnp.float32)
+    else:  # unmasked cross-attention, as T5Model.decode's None path
+        cross_bias = jnp.zeros((B, 1, 1, T_enc), jnp.float32)
+    dec_stacked = _stack_stages(
+        [backbone_params[f"dec_{i}"] for i in range(L_dec)], S
+    )
+    dec_block = T5DecoderBlock(config)
+
+    def dec_stage(stage_params, h, aux_mb):
+        def body(h, p):
+            h, _ = dec_block.apply(
+                {"params": p}, h, aux_mb["sb"], aux_mb["cb"],
+                encoder_hidden=aux_mb["eh"],
+            )
+            return h, None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    y = pipeline_apply(
+        dec_stage, dec_stacked, y, mesh,
+        num_microbatches=num_microbatches,
+        aux={"sb": self_bias, "cb": cross_bias, "eh": encoder_hidden},
+    )
+    hidden = bb(lambda m, v_: m.dec_final_ln(v_), y)
+    logits = bb(T5Model.logits, hidden)
+    return {"logits": logits, "hidden": hidden}
+
+
+def pp_t5_response_forward(
+    config,
+    params,  # T5WithValueHead params: {"t5", "v_head"}
+    input_ids,
+    attention_mask,
+    decoder_input_ids,
+    decoder_attention_mask,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+):
+    """(logits, values) — the seq2seq PPO update's policy forward with the
+    trunk stacks pipelined; the value head reads decoder hidden states
+    (`ppo_models.py:638-641`) replicated over pp."""
+    out = pp_t5_forward(
+        config, params["t5"], input_ids, attention_mask,
+        decoder_input_ids, decoder_attention_mask, mesh, num_microbatches,
+    )
+    v_head = MLPHead(
+        config.d_model, 1, dtype=config.dtype, param_dtype=config.param_dtype
+    )
+    values = v_head.apply({"params": params["v_head"]}, out["hidden"])[..., 0]
+    return out["logits"], values
+
+
+def pp_t5_ref_logits(
+    config,
+    ref_params,  # T5Model params (full frozen copy — the fork's ref path)
+    input_ids,
+    attention_mask,
+    decoder_input_ids,
+    decoder_attention_mask,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+) -> jax.Array:
+    """Frozen-reference logits with the trunk stacks pipelined (the fork
+    uses a full frozen copy for T5 — `ppo_orchestrator.py:41-43`)."""
+    return pp_t5_forward(
+        config, ref_params, input_ids, attention_mask,
+        decoder_input_ids, decoder_attention_mask, mesh, num_microbatches,
+    )["logits"]
 
 
 def pp_ilql_forward(

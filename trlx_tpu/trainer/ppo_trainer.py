@@ -170,43 +170,7 @@ class PPOTrainer(BaseRLTrainer):
         self.pp_microbatches = train.pp_microbatches
         self.pp_virtual_stages = train.pp_virtual_stages
         if self.pp_stages > 1:
-            from trlx_tpu.models.pp_runner import supports_pp
-
-            if not supports_pp(self.model_config):
-                raise NotImplementedError(
-                    f"pp mesh axis is integrated for the causal families "
-                    f"(gpt2/gptj/gpt_neo/gpt_neox) but not "
-                    f"{type(self.model_config).__name__}: MoE layers have "
-                    f"non-uniform per-layer params (no stage stacking); "
-                    f"use dp/fsdp/tp/sp/ep instead"
-                )
-            L = self._n_layers()
-            if L % self.pp_stages:
-                raise ValueError(
-                    f"n_layer={L} must divide into pp={self.pp_stages} "
-                    f"stages"
-                )
-            if config.model.num_layers_unfrozen > 0:
-                # hydra under pp needs the branch point on a stage boundary
-                # (the capture is a stage's input — round 3; previously
-                # refused outright)
-                chunk = L // self.pp_stages
-                branch = L - config.model.num_layers_unfrozen
-                if branch % chunk:
-                    raise NotImplementedError(
-                        f"hydra under pp needs the branch point on a stage "
-                        f"boundary: L={L}, pp={self.pp_stages} gives stage "
-                        f"size {chunk}, but L - num_layers_unfrozen = "
-                        f"{branch}; adjust num_layers_unfrozen or use the "
-                        f"full-copy reference"
-                    )
-                if train.pp_virtual_stages > 1:
-                    raise NotImplementedError(
-                        "hydra under pp runs the v=1 schedule (the branch "
-                        "capture is a single stage's input, which the "
-                        "interleaved schedule does not expose); drop "
-                        "pp_virtual_stages or use the full-copy reference"
-                    )
+            self._validate_pp_mesh(config, train)
 
         gen_kwargs = dict(method.gen_kwargs)
         self.apply_tokenizer_gen_defaults(gen_kwargs)
@@ -371,6 +335,46 @@ class PPOTrainer(BaseRLTrainer):
 
     def _amend_gen_kwargs(self, gen_kwargs: Dict) -> None:
         pass
+
+    def _validate_pp_mesh(self, config, train) -> None:
+        """Family/shape checks for a pp axis > 1 (overridable per trainer:
+        the seq2seq variant validates both T5 stacks instead)."""
+        from trlx_tpu.models.pp_runner import supports_pp
+
+        if not supports_pp(self.model_config):
+            raise NotImplementedError(
+                f"pp mesh axis is integrated for the causal families "
+                f"(gpt2/gptj/gpt_neo/gpt_neox) but not "
+                f"{type(self.model_config).__name__}: MoE layers have "
+                f"non-uniform per-layer params (no stage stacking); "
+                f"use dp/fsdp/tp/sp/ep instead"
+            )
+        L = self._n_layers()
+        if L % self.pp_stages:
+            raise ValueError(
+                f"n_layer={L} must divide into pp={self.pp_stages} stages"
+            )
+        if config.model.num_layers_unfrozen > 0:
+            # hydra under pp needs the branch point on a stage boundary
+            # (the capture is a stage's input — round 3; previously
+            # refused outright)
+            chunk = L // self.pp_stages
+            branch = L - config.model.num_layers_unfrozen
+            if branch % chunk:
+                raise NotImplementedError(
+                    f"hydra under pp needs the branch point on a stage "
+                    f"boundary: L={L}, pp={self.pp_stages} gives stage "
+                    f"size {chunk}, but L - num_layers_unfrozen = "
+                    f"{branch}; adjust num_layers_unfrozen or use the "
+                    f"full-copy reference"
+                )
+            if train.pp_virtual_stages > 1:
+                raise NotImplementedError(
+                    "hydra under pp runs the v=1 schedule (the branch "
+                    "capture is a single stage's input, which the "
+                    "interleaved schedule does not expose); drop "
+                    "pp_virtual_stages or use the full-copy reference"
+                )
 
     def _check_response_budget(self, train) -> None:
         """Every rollout must have >= 1 response token by construction: a

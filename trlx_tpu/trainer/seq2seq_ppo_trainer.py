@@ -68,6 +68,31 @@ class Seq2SeqPPOTrainer(PPOTrainer):
         # a compute-dtype copy would not be bit-identical — keep masters
         return False
 
+    def _validate_pp_mesh(self, config, train) -> None:
+        # pp for seq2seq (round 3): BOTH trunk stacks pipeline in the
+        # update's forwards (`pp_runner.pp_t5_forward`); the compiled
+        # sampler stays GSPMD (params replicated over pp during rollouts —
+        # encoder-cached decode has no stage-resident layout yet)
+        from trlx_tpu.models.pp_runner import supports_pp_seq2seq
+
+        if not supports_pp_seq2seq(self.model_config):
+            raise NotImplementedError(
+                f"seq2seq pp is integrated for the T5 family, not "
+                f"{type(self.model_config).__name__}"
+            )
+        L_enc = self.model_config.num_layers
+        L_dec = self.model_config.num_decoder_layers
+        if L_enc % self.pp_stages or L_dec % self.pp_stages:
+            raise ValueError(
+                f"num_layers={L_enc} and num_decoder_layers={L_dec} must "
+                f"both divide into pp={self.pp_stages} stages"
+            )
+        if train.pp_virtual_stages > 1:
+            raise NotImplementedError(
+                "the interleaved schedule is not wired for the seq2seq "
+                "stacks yet; drop pp_virtual_stages"
+            )
+
     def _check_response_budget(self, train) -> None:
         # For seq2seq, gen max_length caps *decoder* tokens (incl. the
         # start token), independent of the encoder budget train.seq_length;
@@ -139,13 +164,22 @@ class Seq2SeqPPOTrainer(PPOTrainer):
 
     def _forward_logprobs_values(self, params, mb: PPORolloutBatch):
         dec_ids, dec_mask = self._decoder_inputs(mb.response_tokens, mb.response_mask)
-        out = self.model.apply(
-            {"params": params},
-            mb.query_tokens,
-            attention_mask=mb.query_mask,
-            decoder_input_ids=dec_ids,
-            decoder_attention_mask=dec_mask,
-        )
+        if self.pp_stages > 1:
+            from trlx_tpu.models.pp_runner import pp_t5_response_forward
+
+            logits, values = pp_t5_response_forward(
+                self.model_config, params, mb.query_tokens, mb.query_mask,
+                dec_ids, dec_mask, self.mesh, self.pp_microbatches,
+            )
+            out = {"logits": logits, "values": values}
+        else:
+            out = self.model.apply(
+                {"params": params},
+                mb.query_tokens,
+                attention_mask=mb.query_mask,
+                decoder_input_ids=dec_ids,
+                decoder_attention_mask=dec_mask,
+            )
         logprobs = logprobs_from_logits(out["logits"], mb.response_tokens)
         entropy = (
             _policy_entropy(out["logits"])
@@ -162,6 +196,14 @@ class Seq2SeqPPOTrainer(PPOTrainer):
 
     def _ref_logprobs(self, ref_params, policy_params, q_ids, q_mask, r_ids, r_mask):
         dec_ids, dec_mask = self._decoder_inputs(r_ids, r_mask)
+        if self.pp_stages > 1:
+            from trlx_tpu.models.pp_runner import pp_t5_ref_logits
+
+            logits = pp_t5_ref_logits(
+                self.model_config, ref_params, q_ids, q_mask,
+                dec_ids, dec_mask, self.mesh, self.pp_microbatches,
+            )
+            return logprobs_from_logits(logits, r_ids)
         out = self.backbone.apply(
             {"params": ref_params},
             q_ids,
