@@ -129,11 +129,20 @@ def pipeline_apply_cached(
     virtual_stages: int = 1,
     capture_stage: int = None,
     capture_only: bool = False,
+    static_cache=None,
 ):
     """The pipeline schedule — one implementation for all three uses:
     cache-less train forward (via :func:`pipeline_apply`), rollout decode
     with STAGE-RESIDENT KV caches, and the interleaved train schedule
     (``virtual_stages > 1``, cache-less only).
+
+    ``static_cache`` (optional): a READ-ONLY stage-resident tree with the
+    same layer-major ``[L, B, ...]`` layout and ``P(pp, batch)`` sharding
+    as ``cache`` — e.g. precomputed seq2seq cross-attention K/V. It is
+    microbatch-sliced like the cache and handed to ``stage_fn`` as an
+    extra argument before ``cache_index`` (signature becomes
+    ``stage_fn(params, h, aux_mb, cache_mb, static_mb, cache_index)``)
+    but never written back.
 
     ``capture_stage=k`` additionally collects the activation ENTERING stage
     k for every microbatch (the hydra shared-trunk branch point — the
@@ -207,7 +216,7 @@ def pipeline_apply_cached(
                     f"but the {axis_name!r} axis has {S} devices (one stage "
                     f"per device); extra stages would be silently dropped"
                 )
-    for leaf in jax.tree_util.tree_leaves(cache):
+    for leaf in jax.tree_util.tree_leaves((cache, static_cache)):
         if leaf.shape[0] % S:
             raise ValueError(
                 f"cache layer dim {leaf.shape[0]} must divide pp={S}"
@@ -220,7 +229,7 @@ def pipeline_apply_cached(
             f"{M} microbatches"
         )
 
-    def local(params, x, cache, cache_index, aux):
+    def local(params, x, cache, static, cache_index, aux):
         params = jax.tree_util.tree_map(lambda p: p[0], params)
         idx = jax.lax.axis_index(axis_name)
         n = jax.lax.psum(1, axis_name)
@@ -275,15 +284,19 @@ def pipeline_apply_cached(
                     caps,
                 )
             aux_m = jax.tree_util.tree_map(lambda a: a[m_c], aux_mbs)
-            old_mb = jax.tree_util.tree_map(
-                lambda c_: jax.lax.dynamic_slice_in_dim(
-                    c_, m_c * bm, bm, axis=1
-                ),
-                cache,
+            mb_slice = lambda c_: jax.lax.dynamic_slice_in_dim(
+                c_, m_c * bm, bm, axis=1
             )
-            h_out, new_mb = stage_fn(
-                chunk_params, h_in, aux_m, old_mb, cache_index
-            )
+            old_mb = jax.tree_util.tree_map(mb_slice, cache)
+            if static_cache is None:
+                h_out, new_mb = stage_fn(
+                    chunk_params, h_in, aux_m, old_mb, cache_index
+                )
+            else:
+                static_mb = jax.tree_util.tree_map(mb_slice, static)
+                h_out, new_mb = stage_fn(
+                    chunk_params, h_in, aux_m, old_mb, static_mb, cache_index
+                )
             # bubble ticks compute on garbage: mask their cache writes
             new_mb = jax.tree_util.tree_map(
                 lambda nk, ok: jnp.where(active, nk.astype(ok.dtype), ok),
@@ -350,9 +363,12 @@ def pipeline_apply_cached(
         if capture_stage is None
         else (x_spec, cache_specs, x_spec)
     )
+    static_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name, batch_axes), static_cache
+    )
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs, x_spec, cache_specs, P(), aux_specs),
+        in_specs=(param_specs, x_spec, cache_specs, static_specs, P(), aux_specs),
         out_specs=out_specs,
-    )(stacked_params, x, cache, cache_index, aux)
+    )(stacked_params, x, cache, static_cache, cache_index, aux)
