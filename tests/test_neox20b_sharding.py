@@ -108,3 +108,14 @@ def test_per_chip_bytes_fit_v4_budget(plan):
     # params(bf16) + grads(bf16) + adam m+v (f32-equivalent budget: 2x4B)
     per_chip_total = per_chip_param_bytes * 2 + per_chip_param_bytes / 2 * 8
     assert per_chip_total < 16e9, f"{per_chip_total/1e9:.1f} GB/chip"
+
+    # with train.adam_moment_dtype "bfloat16" (stochastic-rounded stores,
+    # trainer/common.py) the m+v budget halves to 2x2B — the headroom is
+    # exactly the moments' f32-vs-bf16 delta, ~2.4 GB/chip at this topology
+    per_chip_bf16_moments = (
+        per_chip_param_bytes * 2 + per_chip_param_bytes / 2 * 4
+    )
+    saved = per_chip_total - per_chip_bf16_moments
+    assert per_chip_bf16_moments < per_chip_total - 2e9, (
+        f"{per_chip_bf16_moments/1e9:.1f} GB/chip, saved {saved/1e9:.1f}"
+    )

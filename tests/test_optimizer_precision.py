@@ -1,0 +1,193 @@
+"""Optimizer-state precision: bf16 Adam moments with stochastic rounding
+(`train.adam_moment_dtype: "bfloat16"`, trainer/common.py).
+
+The reference has no optimizer-precision options (plain torch AdamW,
+`accelerate_base_model.py:94-106`); this is a TPU-scale extension — halved
+optimizer HBM traffic per step and halved resident moment bytes for the
+20B stretch (see test_neox20b_sharding.py budget). These tests pin the
+three claims that make it safe: the rounding is unbiased, sub-resolution
+EMA increments still accumulate (the failure mode of round-to-nearest),
+and end-to-end learning matches f32 moments."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def test_stochastic_round_is_unbiased():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.trainer.common import stochastic_round
+
+    # values straddling bf16 grid points, several magnitudes
+    x = jnp.asarray(
+        [1.0 + 2**-9, -3.7e-4, 0.123456, 5.0e5, -1.0 - 2**-10], jnp.float32
+    )
+    keys = jax.random.split(jax.random.key(0), 4096)
+    rounded = jax.vmap(
+        lambda k: stochastic_round(x, k, jnp.bfloat16).astype(jnp.float32)
+    )(keys)
+    mean = np.asarray(rounded.mean(axis=0))
+    # bf16 spacing at |x| is ~|x|*2^-8; the mean over 4k draws must land
+    # well inside one ulp of the true value
+    ulp = np.abs(np.asarray(x)) * 2.0**-8
+    assert np.all(np.abs(mean - np.asarray(x)) < 0.15 * ulp + 1e-12), (
+        mean,
+        np.asarray(x),
+    )
+
+
+def test_stochastic_round_accumulates_subresolution_ema():
+    """nu = b2*nu + (1-b2)*g^2 with b2=0.999: the increment is ~1000x below
+    nu's fixpoint, far below bf16 resolution (2^-8). Round-to-nearest bf16
+    stalls; stochastic rounding tracks the f32 EMA."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.trainer.common import stochastic_round
+
+    b2, g2 = 0.999, 1.0
+    n = 2000
+    nu_f32 = 0.0
+    nu_sr = jnp.zeros((256,), jnp.bfloat16)  # 256 parallel lanes
+    nu_rtn = jnp.bfloat16(0.0)
+    for t in range(n):
+        nu_f32 = b2 * nu_f32 + (1 - b2) * g2
+        key = jax.random.fold_in(jax.random.key(7), t)
+        nu_sr = stochastic_round(
+            b2 * nu_sr.astype(jnp.float32) + (1 - b2) * g2, key, jnp.bfloat16
+        )
+        nu_rtn = (
+            b2 * nu_rtn.astype(jnp.float32) + (1 - b2) * g2
+        ).astype(jnp.bfloat16)
+    sr_mean = float(nu_sr.astype(jnp.float32).mean())
+    assert abs(sr_mean - nu_f32) < 0.05 * nu_f32, (sr_mean, nu_f32)
+    # round-to-nearest stalls once the increment drops below one ulp: it
+    # must sit measurably below the true EMA by then
+    assert float(nu_rtn) < 0.9 * nu_f32, (float(nu_rtn), nu_f32)
+
+
+def test_bf16_moments_match_f32_trajectory():
+    """AdamW with bf16+SR moments follows the f32-moment trajectory on a
+    noisy linear regression: params stay within ~1% relative after 300
+    steps (per-step rounding noise is unbiased and averages out)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from trlx_tpu.data.configs import TrainConfig
+    from trlx_tpu.trainer.common import make_optimizer
+
+    def run(moment_dtype):
+        cfg = TrainConfig.from_dict(
+            {
+                "lr_init": 1e-2,
+                "lr_target": 1e-2,
+                "opt_betas": [0.9, 0.999],
+                "adam_moment_dtype": moment_dtype,
+            }
+        )
+        tx = make_optimizer(cfg, total_steps=300)
+        key = jax.random.key(3)
+        w_true = jax.random.normal(jax.random.fold_in(key, 1), (16,))
+        params = {"w": jnp.zeros((16,)), "b": jnp.zeros(())}
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, k):
+            x = jax.random.normal(k, (32, 16))
+            y = x @ w_true + 0.01 * jax.random.normal(jax.random.fold_in(k, 9), (32,))
+
+            def loss_fn(p):
+                pred = x @ p["w"] + p["b"]
+                return jnp.mean((pred - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        for t in range(300):
+            params, opt_state, loss = step(
+                params, opt_state, jax.random.fold_in(key, 100 + t)
+            )
+        return np.asarray(params["w"]), float(loss)
+
+    w32, loss32 = run("float32")
+    wbf, lossbf = run("bfloat16")
+    assert np.linalg.norm(wbf - w32) < 0.02 * max(np.linalg.norm(w32), 1.0), (
+        np.linalg.norm(wbf - w32),
+        np.linalg.norm(w32),
+    )
+    assert lossbf < 2.0 * loss32 + 1e-3, (lossbf, loss32)
+
+
+def test_ppo_learns_with_bf16_moments():
+    """End-to-end learning parity (VERDICT r3 #8): the fast synthetic PPO
+    task from test_learning.py still learns with bf16 moments."""
+    os.environ["WANDB_DISABLED"] = "1"
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    from test_learning import assert_reward_improved, make_target_reward
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "model_arch": {
+                    "vocab_size": 16,
+                    "n_positions": 16,
+                    "n_embd": 32,
+                    "n_layer": 2,
+                    "n_head": 2,
+                },
+            },
+            "train": {
+                "seq_length": 4,
+                "batch_size": 16,
+                "epochs": 12,
+                "total_steps": 96,
+                "eval_interval": 1000,
+                "checkpoint_interval": 100000,
+                "lr_init": 1.0e-3,
+                "lr_target": 1.0e-3,
+                "adam_moment_dtype": "bfloat16",
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "float32",
+                "seed": 7,
+            },
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 64,
+                "chunk_size": 64,
+                "ppo_epochs": 2,
+                "init_kl_coef": 0.001,
+                "scale_reward": None,
+                "gen_kwargs": {
+                    "max_new_tokens": 6,
+                    "min_new_tokens": 6,
+                    "top_k": 0,
+                    "do_sample": True,
+                    "eos_token_id": 14,
+                    "pad_token_id": 15,
+                },
+            },
+        }
+    )
+
+    phase_means = []
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 13, size=rng.integers(1, 4))) for _ in range(64)]
+    trlx_tpu.train(
+        reward_fn=make_target_reward(phase_means),
+        prompts=prompts,
+        eval_prompts=prompts[:16],
+        config=config,
+    )
+    assert_reward_improved(phase_means)

@@ -91,6 +91,12 @@ class TrainConfig:
     opt_eps: float = 1.0e-8
     weight_decay: float = 1.0e-6
     grad_clip: float = 1.0
+    # Storage dtype for BOTH Adam moments ("float32" | "bfloat16"). bf16
+    # halves the optimizer's resident bytes and its per-step HBM read+write
+    # (measured ~24% of the bench train step at f32); stores use stochastic
+    # rounding so sub-resolution EMA increments ((1-b2)·g²) still
+    # accumulate. Update math stays f32. See trainer/common.py.
+    adam_moment_dtype: str = "float32"
 
     checkpoint_interval: int = 10000
     eval_interval: int = 100
