@@ -33,8 +33,11 @@ class TrainState:
 def unfrozen_param_mask(params: Any, num_layers_unfrozen: int, n_layer: int) -> Any:
     """True for trainable leaves. With ``num_layers_unfrozen=k > 0``, only the
     top-k transformer blocks + final layernorm + heads train (reference
-    freezes everything below the branch point)."""
-    if num_layers_unfrozen < 0:
+    freezes everything below the branch point). ``k <= 0`` trains everything
+    — the reference's ``freeze_bottom_causal_layers`` freezes nothing at 0
+    (its hidden-layer slice is empty unless k > 0), and the fork's own
+    ``ppo_config.yml:5`` uses 0 for full training."""
+    if num_layers_unfrozen <= 0:
         return jax.tree_util.tree_map(lambda _: True, params)
     first_trainable = n_layer - num_layers_unfrozen
 
@@ -146,6 +149,21 @@ def scale_by_adam_low_precision(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def stop_frozen_gradients(params: Any, trainable_mask: Optional[Any]) -> Any:
+    """``stop_gradient`` on every frozen param leaf, for use *inside* a
+    ``loss_fn`` before the forward. The gradients of frozen leaves become
+    structural zeros, so XLA dead-code-eliminates their entire backward —
+    with bottom-layer freezing that prunes the backprop below the branch
+    point (the reference gets the same pruning from requires_grad=False).
+    Also makes clip_by_global_norm see only trainable gradients, matching
+    torch's behavior where frozen params simply have no .grad."""
+    if trainable_mask is None or all(jax.tree_util.tree_leaves(trainable_mask)):
+        return params
+    return jax.tree_util.tree_map(
+        lambda p, t: p if t else jax.lax.stop_gradient(p), params, trainable_mask
+    )
+
+
 def make_optimizer(
     train_config: TrainConfig,
     total_steps: int,
@@ -165,12 +183,15 @@ def make_optimizer(
         if train_config.lr_init
         else 1.0,
     )
-    moment_dtype = jnp.dtype(train_config.adam_moment_dtype)
-    if moment_dtype not in (jnp.float32, jnp.bfloat16):
+    if train_config.adam_moment_dtype not in ("float32", "bfloat16"):
+        # validate the raw string BEFORE jnp.dtype — an unknown name (e.g.
+        # the natural typo "bf16") would otherwise die in numpy's opaque
+        # TypeError instead of this message
         raise ValueError(
             f"train.adam_moment_dtype must be float32 or bfloat16, got "
             f"{train_config.adam_moment_dtype!r}"
         )
+    moment_dtype = jnp.dtype(train_config.adam_moment_dtype)
     if moment_dtype == jnp.float32:
         adam = optax.adamw(
             learning_rate=schedule,
@@ -190,16 +211,42 @@ def make_optimizer(
             optax.add_decayed_weights(train_config.weight_decay),
             optax.scale_by_learning_rate(schedule),
         )
-    tx = optax.chain(
-        optax.clip_by_global_norm(train_config.grad_clip),
-        adam,
-    )
-    if trainable_mask is not None:
+    if trainable_mask is not None and not all(
+        jax.tree_util.tree_leaves(trainable_mask)
+    ):
+        # Frozen leaves carry NO optimizer state and see no Adam traffic
+        # (optax.masked skips them entirely) — with bottom-layer freezing
+        # the moments shrink to the trainable slice, exactly as torch's
+        # requires_grad=False does for the reference. The trailing
+        # set_to_zero is a hard guarantee that frozen params never move
+        # even if a caller feeds unstopped gradients. (Checkpoints from
+        # the earlier full-size-moment masked layout do not restore into
+        # this structure — frozen-mask runs must restart.)
         tx = optax.chain(
-            tx,
+            optax.clip_by_global_norm(train_config.grad_clip),
+            optax.masked(adam, trainable_mask),
             optax.masked(
                 optax.set_to_zero(),
                 jax.tree_util.tree_map(lambda t: not t, trainable_mask),
             ),
+        )
+    elif trainable_mask is not None:
+        # all-trainable: keep the historical opt-state pytree structure
+        # (chain(chain(clip, adam), masked(set_to_zero, all-False))) so
+        # pre-existing Orbax checkpoints of default-config runs still
+        # restore leaf-for-leaf
+        tx = optax.chain(
+            optax.chain(
+                optax.clip_by_global_norm(train_config.grad_clip), adam
+            ),
+            optax.masked(
+                optax.set_to_zero(),
+                jax.tree_util.tree_map(lambda t: not t, trainable_mask),
+            ),
+        )
+    else:
+        tx = optax.chain(
+            optax.clip_by_global_norm(train_config.grad_clip),
+            adam,
         )
     return tx

@@ -37,7 +37,11 @@ from trlx_tpu.parallel import (
     replicated,
 )
 from trlx_tpu.trainer import BaseRLTrainer, register_trainer
-from trlx_tpu.trainer.common import make_optimizer, unfrozen_param_mask
+from trlx_tpu.trainer.common import (
+    make_optimizer,
+    stop_frozen_gradients,
+    unfrozen_param_mask,
+)
 from trlx_tpu.utils import Clock, set_seed
 from trlx_tpu.utils.checkpoint import (
     has_checkpoint,
@@ -147,6 +151,7 @@ class ILQLTrainer(BaseRLTrainer):
         trainable = unfrozen_param_mask(
             params, config.model.num_layers_unfrozen, num_layers_of(self.model_config)
         )
+        self.trainable_mask = trainable
         self.tx = make_optimizer(train, train.total_steps, trainable)
         opt_shapes = jax.eval_shape(self.tx.init, params)
         self.opt_shardings = self._shardings_for(opt_shapes)
@@ -234,6 +239,9 @@ class ILQLTrainer(BaseRLTrainer):
 
         def train_step(state: ILQLTrainState, mb: ILQLBatch):
             def loss_fn(params):
+                # prune the backward below the freezing boundary (reference
+                # `ilql_models.py:217-225` freezes via requires_grad=False)
+                params = stop_frozen_gradients(params, self.trainable_mask)
                 if self.pp_stages > 1:
                     from trlx_tpu.models.pp_runner import pp_ilql_forward
 
