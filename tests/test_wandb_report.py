@@ -1,0 +1,163 @@
+"""Execute the sweep->wandb reporting subsystem against a stubbed wandb
+(VERDICT r3 #4: `sweep/wandb_report.py` was the one subsystem never run —
+wandb is not installable here). The stub records every call so the tests
+pin the replay/report structure the reference produces
+(`trlx/ray_tune/wandb.py:47-82` run replay, `:85-214` report blocks)."""
+
+import importlib
+import os
+import sys
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+class FakeRun:
+    def __init__(self, kwargs):
+        self.kwargs = kwargs
+        self.logged = []
+        self.finished = False
+
+    def log(self, row):
+        self.logged.append(dict(row))
+
+    def finish(self):
+        self.finished = True
+
+
+class _Panel:
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.kwargs})"
+
+
+class FakeReport(_Panel):
+    saved = []
+
+    def save(self):
+        FakeReport.saved.append(self)
+
+
+@pytest.fixture()
+def wandb_stub(monkeypatch):
+    wandb = types.ModuleType("wandb")
+    wandb.runs = []
+
+    def init(**kwargs):
+        run = FakeRun(kwargs)
+        wandb.runs.append(run)
+        return run
+
+    wandb.init = init
+
+    reports = types.ModuleType("wandb.apis.reports")
+    for name in (
+        "PanelGrid", "Runset", "ParallelCoordinatesPlot", "PCColumn",
+        "ParameterImportancePlot", "ScatterPlot", "LinePlot", "MarkdownBlock",
+    ):
+        setattr(reports, name, type(name, (_Panel,), {}))
+    # PCColumn is constructed positionally in wandb_report.py
+    reports.PCColumn = type(
+        "PCColumn", (), {"__init__": lambda self, col: setattr(self, "col", col)}
+    )
+    reports.Report = FakeReport
+    FakeReport.saved = []
+
+    apis = types.ModuleType("wandb.apis")
+    apis.reports = reports
+    wandb.apis = apis
+
+    monkeypatch.setitem(sys.modules, "wandb", wandb)
+    monkeypatch.setitem(sys.modules, "wandb.apis", apis)
+    monkeypatch.setitem(sys.modules, "wandb.apis.reports", reports)
+    monkeypatch.setenv("WANDB_DISABLED", "")
+    import trlx_tpu.sweep.wandb_report as wr
+
+    importlib.reload(wr)
+    return wandb, reports, wr
+
+
+TRIALS = [
+    {
+        "params": {"lr_init": 1e-4, "init_kl_coef": 0.05},
+        "result": {"reward/mean": 0.8},
+        "history": [
+            {"reward/mean": 0.1, "losses/total_loss": 2.0},
+            {"reward/mean": 0.5, "losses/total_loss": 1.0},
+        ],
+    },
+    {
+        "params": {"lr_init": 3e-4, "init_kl_coef": 0.2},
+        "result": {"reward/mean": 0.3},
+        "history": [],
+    },
+]
+BEST = {"params": TRIALS[0]["params"], "result": TRIALS[0]["result"]}
+SPACE = {"lr_init": {"strategy": "loguniform", "values": [1e-5, 1e-3]},
+         "init_kl_coef": {"strategy": "uniform", "values": [0.01, 0.5]}}
+
+
+def test_log_trials_replays_each_trial(wandb_stub):
+    wandb, _, wr = wandb_stub
+    wr.log_trials(TRIALS, {"metric": "reward/mean"}, project="proj-x")
+    assert len(wandb.runs) == 2
+    r0, r1 = wandb.runs
+    assert r0.kwargs["project"] == "proj-x" and r0.kwargs["name"] == "trial-0"
+    assert r0.kwargs["config"] == TRIALS[0]["params"]
+    # per-step history replayed in order, then the final result row
+    assert r0.logged == TRIALS[0]["history"] + [TRIALS[0]["result"]]
+    assert r1.logged == [TRIALS[1]["result"]]
+    assert r0.finished and r1.finished
+
+
+def test_create_report_block_structure(wandb_stub):
+    _, reports, wr = wandb_stub
+    wr.create_report("proj-x", SPACE, "reward/mean", TRIALS, BEST)
+    assert len(FakeReport.saved) == 1
+    report = FakeReport.saved[0]
+    assert "reward/mean" in report.kwargs["title"]
+    assert str(BEST["params"]) in report.kwargs["description"]
+
+    grids = [b for b in report.blocks if isinstance(b, reports.PanelGrid)]
+    md = [b for b in report.blocks if isinstance(b, reports.MarkdownBlock)]
+    assert len(grids) == 2 and len(md) == 1  # main grid + line grid + best
+    assert report.blocks[-1] is md[0]
+    assert str(BEST["params"]) in md[0].kwargs["text"]
+
+    main_panels = grids[0].kwargs["panels"]
+    pc = [p for p in main_panels if isinstance(p, reports.ParallelCoordinatesPlot)]
+    imp = [p for p in main_panels if isinstance(p, reports.ParameterImportancePlot)]
+    sc = [p for p in main_panels if isinstance(p, reports.ScatterPlot)]
+    assert len(pc) == 1 and len(imp) == 1 and len(sc) == 1
+    # PC columns: one per swept param + the target metric
+    cols = [c.col for c in pc[0].kwargs["columns"]]
+    assert cols == ["c::lr_init", "c::init_kl_coef", "reward/mean"]
+    assert imp[0].kwargs["with_respect_to"] == "reward/mean"
+
+    # per-metric line plots: the target metric first, then history metrics
+    line_ys = [p.kwargs["y"] for p in grids[1].kwargs["panels"]
+               if isinstance(p, reports.LinePlot)]
+    assert line_ys[0] == ["reward/mean"]
+    assert ["losses/total_loss"] in line_ys
+
+
+def test_create_report_without_history_skips_line_grid(wandb_stub):
+    _, reports, wr = wandb_stub
+    plain = [dict(t, history=[]) for t in TRIALS]
+    wr.create_report("proj-x", SPACE, "reward/mean", plain, BEST)
+    report = FakeReport.saved[-1]
+    grids = [b for b in report.blocks if isinstance(b, reports.PanelGrid)]
+    assert len(grids) == 1  # single-point runs render nothing a scatter doesn't
+
+
+def test_disabled_is_a_noop(wandb_stub, monkeypatch):
+    wandb, _, wr = wandb_stub
+    monkeypatch.setenv("WANDB_DISABLED", "1")
+    wr.log_trials(TRIALS, {}, project="p")
+    wr.create_report("p", SPACE, "reward/mean", TRIALS, BEST)
+    assert not wandb.runs and not FakeReport.saved

@@ -91,11 +91,26 @@ def cli(argv=None):
         with open(args.output, "w") as f:
             json.dump({"best": best, "trials": trials}, f, indent=2, default=float)
         try:
-            from trlx_tpu.sweep.wandb_report import log_trials
+            # reference tune_function does both: replay trials into wandb
+            # runs, then assemble the programmatic report (`sweep.py:36-47`);
+            # one resolved project for both, or the report's runsets would
+            # query a project the runs were never logged to
+            from trlx_tpu.sweep.wandb_report import create_report, log_trials
 
-            log_trials(trials, tune_config)
-        except Exception:
-            pass
+            project = os.environ.get("WANDB_PROJECT", "trlx_tpu-sweeps")
+            log_trials(trials, tune_config, project=project)
+            create_report(
+                project,
+                param_space,
+                tune_config.get("metric", "reward/mean"),
+                trials,
+                best,
+            )
+        except Exception as e:
+            # reporting is best-effort, but never silently: the sweep
+            # result (best config + trials json) is already on disk
+            print(f"wandb reporting failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     return best
 
 
