@@ -34,6 +34,9 @@ from trlx_tpu.ops.attention import causal_dispatch, dot_product_attention
 Cache = Tuple[Dict[str, jax.Array], ...]
 
 
+VALID_KV_CACHE_DTYPES = ("bfloat16", "int8", "auto")
+
+
 @dataclass(frozen=True)
 class GPT2Config:
     """Architecture hyperparameters (HF ``GPT2Config`` field names)."""
@@ -52,14 +55,16 @@ class GPT2Config:
     # (token, head) on write (absmax/127 scale), dequantized on read
     # inside the attention matmul's operand fusion. Training/scoring
     # forwards never touch this — only the sampler's cache buffers.
-    kv_cache_dtype: str = "bfloat16"  # "bfloat16" | "int8"
+    # "auto" resolves per cache shape: int8 below the measured capacity
+    # crossover (INT8_KV_MAX_CAPACITY), bf16 beyond it.
+    kv_cache_dtype: str = "bfloat16"  # "bfloat16" | "int8" | "auto"
 
     def __post_init__(self):
-        if self.kv_cache_dtype not in ("bfloat16", "int8"):
+        if self.kv_cache_dtype not in VALID_KV_CACHE_DTYPES:
             raise ValueError(
                 f"kv_cache_dtype={self.kv_cache_dtype!r} is not supported "
-                "(choose 'bfloat16' or 'int8') — an unrecognized value "
-                "would otherwise silently fall back to bf16 buffers"
+                f"(choose one of {VALID_KV_CACHE_DTYPES}) — an unrecognized "
+                "value would otherwise silently fall back to bf16 buffers"
             )
 
     @classmethod
@@ -289,6 +294,35 @@ def write_cache(cache_kv, k, v, cache_index, dtype):
     return k, v, {"k": k, "v": v}
 
 
+# Measured crossover for the int8 KV cache (LONGCTX.json): int8 wins 1.10x
+# at capacity 112 (the B=128 rollout shape — cache traffic dominates and
+# the dequant folds into the attention matmul read) but loses ~2x at a 2k
+# cache (B=8 long-context decode — XLA materializes the dequantized bf16
+# buffer instead of fusing the int8*scale read). The threshold sits
+# conservatively between the two measured points; a dequant-fused Pallas
+# decode read is the known fix if long-context rollouts ever dominate.
+INT8_KV_MAX_CAPACITY = 512
+
+
+def resolve_kv_cache_dtype(kv_cache_dtype: str, capacity: int) -> str:
+    """Resolve ``"auto"`` by cache capacity and warn when an explicit
+    ``"int8"`` is forced past the measured crossover — a long-context
+    config must not silently decode 2x slower (VERDICT r3 #6)."""
+    if kv_cache_dtype == "auto":
+        return "int8" if capacity <= INT8_KV_MAX_CAPACITY else "bfloat16"
+    if kv_cache_dtype == "int8" and capacity > INT8_KV_MAX_CAPACITY:
+        import warnings
+
+        warnings.warn(
+            f"kv_cache_dtype='int8' with a {capacity}-token cache: measured "
+            f"~2x SLOWER than bfloat16 beyond ~{INT8_KV_MAX_CAPACITY} "
+            "(LONGCTX.json decode, B=8/2k — XLA materializes the "
+            "dequantized buffer); set kv_cache_dtype='auto' to pick the "
+            "faster layout per shape, or 'bfloat16' to silence this"
+        )
+    return kv_cache_dtype
+
+
 def kv_buffers(
     n_layer: int,
     batch_size: int,
@@ -300,8 +334,10 @@ def kv_buffers(
 ) -> Cache:
     """Per-layer fixed-capacity KV buffers, shared by every causal family.
     ``"int8"`` stores int8 values + per (token, head) bf16 scales — ~half
-    the HBM traffic of a bf16 cache (`write_cache` handles both)."""
+    the HBM traffic of a bf16 cache (`write_cache` handles both);
+    ``"auto"`` picks int8 only below the measured capacity crossover."""
     shape = (batch_size, capacity, n_head, head_dim)
+    kv_cache_dtype = resolve_kv_cache_dtype(kv_cache_dtype, capacity)
     if kv_cache_dtype == "int8":
         sshape = (batch_size, capacity, n_head, 1)
         return tuple(

@@ -42,10 +42,13 @@ class NeoXConfig:
     kv_cache_dtype: str = "bfloat16"
 
     def __post_init__(self):
-        if self.kv_cache_dtype not in ("bfloat16", "int8"):
+        from trlx_tpu.models.gpt2 import VALID_KV_CACHE_DTYPES
+
+        if self.kv_cache_dtype not in VALID_KV_CACHE_DTYPES:
             raise ValueError(
                 f"kv_cache_dtype={self.kv_cache_dtype!r} is not supported "
-                "(choose 'bfloat16' or 'int8')"
+                f"(choose one of {VALID_KV_CACHE_DTYPES}) — an unrecognized "
+                "value would otherwise silently fall back to bf16 buffers"
             )
 
     @classmethod

@@ -629,7 +629,11 @@ def pp_init_cache(config, batch_size: int, capacity: int):
     H = n_heads_of(config)
     head_dim = hidden_size_of(config) // H
     shape = (L, batch_size, capacity, H, head_dim)
-    kv_dtype = getattr(config, "kv_cache_dtype", "bfloat16")
+    from trlx_tpu.models.gpt2 import resolve_kv_cache_dtype
+
+    kv_dtype = resolve_kv_cache_dtype(
+        getattr(config, "kv_cache_dtype", "bfloat16"), capacity
+    )
     if kv_dtype == "int8":
         sshape = shape[:-1] + (1,)
         return {
