@@ -37,6 +37,16 @@ Cache = Tuple[Dict[str, jax.Array], ...]
 VALID_KV_CACHE_DTYPES = ("bfloat16", "int8", "auto")
 
 
+def validate_kv_cache_dtype(value: str) -> None:
+    """Shared __post_init__ validation for every causal family config."""
+    if value not in VALID_KV_CACHE_DTYPES:
+        raise ValueError(
+            f"kv_cache_dtype={value!r} is not supported (choose one of "
+            f"{VALID_KV_CACHE_DTYPES}) — an unrecognized value would "
+            "otherwise silently fall back to bf16 buffers"
+        )
+
+
 @dataclass(frozen=True)
 class GPT2Config:
     """Architecture hyperparameters (HF ``GPT2Config`` field names)."""
@@ -60,12 +70,7 @@ class GPT2Config:
     kv_cache_dtype: str = "bfloat16"  # "bfloat16" | "int8" | "auto"
 
     def __post_init__(self):
-        if self.kv_cache_dtype not in VALID_KV_CACHE_DTYPES:
-            raise ValueError(
-                f"kv_cache_dtype={self.kv_cache_dtype!r} is not supported "
-                f"(choose one of {VALID_KV_CACHE_DTYPES}) — an unrecognized "
-                "value would otherwise silently fall back to bf16 buffers"
-            )
+        validate_kv_cache_dtype(self.kv_cache_dtype)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "GPT2Config":

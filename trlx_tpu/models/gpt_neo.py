@@ -69,20 +69,15 @@ class GPTNeoConfig:
     layer_norm_epsilon: float = 1e-5
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
-    # rollout KV-cache storage ("bfloat16" | "int8"); see
+    # rollout KV-cache storage ("bfloat16" | "int8" | "auto"); see
     # models/gpt2.py::write_cache — decode is HBM-bound and the
     # cache is its dominant traffic, int8 halves it
     kv_cache_dtype: str = "bfloat16"
 
     def __post_init__(self):
-        from trlx_tpu.models.gpt2 import VALID_KV_CACHE_DTYPES
+        from trlx_tpu.models.gpt2 import validate_kv_cache_dtype
 
-        if self.kv_cache_dtype not in VALID_KV_CACHE_DTYPES:
-            raise ValueError(
-                f"kv_cache_dtype={self.kv_cache_dtype!r} is not supported "
-                f"(choose one of {VALID_KV_CACHE_DTYPES}) — an unrecognized "
-                "value would otherwise silently fall back to bf16 buffers"
-            )
+        validate_kv_cache_dtype(self.kv_cache_dtype)
 
     @property
     def layer_types(self) -> Tuple[str, ...]:

@@ -782,3 +782,176 @@ def make_pp_sampler_apply(
         return {"logits": logits, "values": values, "cache": new_cache}
 
     return apply_fn
+
+
+# ----------------------- pp seq2seq rollout decode ----------------------- #
+#
+# The T5 family's rollouts under a pp mesh (VERDICT r3 #3 — previously the
+# compiled seq2seq sampler stayed GSPMD with params replicated over pp):
+# - the ENCODER runs once per chunk through the same GPipe schedule as the
+#   update's forward, with its blocks stage-stacked and resident;
+# - the decoder self-attention KV cache is layer-major [L_dec, B, cap, H,
+#   d_kv] sharded P(pp, batch) — each device holds its stage's cache only;
+# - the cross-attention K/V are precomputed ONCE per chunk from the encoder
+#   output (one batched einsum over the layer-stacked EncDecAttention
+#   projections) into the same layer-major stage-resident layout, and ride
+#   the schedule as pipeline_apply_cached's READ-ONLY ``static_cache``;
+# - embeddings, rel-pos bias tables, final LayerNorms, LM head, and the
+#   value head stay replicated over pp (small, need the full batch).
+#
+# Reference capability being scaled: the fork's T5 generate path
+# (`ppo_models.py:620-622`), which on torch runs a full replicated model.
+
+
+def pp_t5_init_cache(config, batch_size: int, capacity: int):
+    """Layer-major decoder self-attn KV buffers for pp seq2seq decode
+    (bf16 — the t5 cache ships bf16 only, matching `init_t5_cache`)."""
+    shape = (
+        config.num_decoder_layers, batch_size, capacity,
+        config.num_heads, config.d_kv,
+    )
+    dtype = jnp.dtype(config.dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def pp_t5_stack_sampler_params(config, mesh: Mesh, params):
+    """Stack BOTH T5 stacks' blocks for the pp sampler, once per invocation
+    (the seq2seq analogue of :func:`pp_stack_sampler_params`)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    S = mesh.shape["pp"]
+    t5 = params["t5"]
+    pin = lambda tree: jax.tree_util.tree_map(
+        lambda p: jax.lax.with_sharding_constraint(
+            p, NamedSharding(mesh, PartitionSpec("pp"))
+        ),
+        tree,
+    )
+    return {
+        **params,
+        "enc_stacked": pin(_stack_stages(
+            [t5[f"enc_{i}"] for i in range(config.num_layers)], S
+        )),
+        "dec_stacked": pin(_stack_stages(
+            [t5[f"dec_{i}"] for i in range(config.num_decoder_layers)], S
+        )),
+    }
+
+
+def make_pp_seq2seq_sampler_fns(config, mesh: Mesh, num_microbatches: int = 2):
+    """``(encode_fn, decode_fn, init_cross_kv_fn)`` for
+    ``ops.sampling.make_seq2seq_sampler`` under a pp mesh. All three consume
+    the PACKED param tree from :func:`pp_t5_stack_sampler_params`. Bias
+    construction mirrors ``T5Model.encode`` / ``T5Model.decode`` exactly
+    (token-exact parity vs the GSPMD sampler is pinned in
+    ``tests/test_pp_integration.py``)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from trlx_tpu.models.t5 import T5DecoderBlock, T5EncoderBlock, T5Model
+    from trlx_tpu.ops.attention import NEG_INF
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+    from trlx_tpu.parallel.pipeline import pipeline_apply_cached
+
+    backbone = T5Model(config)
+    dtype = jnp.dtype(config.dtype)
+    v_head = MLPHead(
+        config.d_model, 1, dtype=config.dtype, param_dtype=config.param_dtype
+    )
+    resident = NamedSharding(mesh, PartitionSpec("pp", BATCH_AXES))
+
+    def bb(t5_params, fn, *args):
+        return backbone.apply({"params": t5_params}, *args, method=fn)
+
+    def encode_fn(packed, input_ids, attention_mask):
+        t5p = packed["t5"]
+        B, T_enc = input_ids.shape
+        x = bb(t5p, lambda m, i: m.shared(i).astype(dtype), input_ids)
+        pos = jnp.arange(T_enc)
+        enc_bias = bb(t5p, lambda m, q, k: m.enc_rel_bias(q, k), pos, pos)
+        enc_bias = enc_bias + jnp.where(
+            attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
+        )
+        enc_bias = jnp.broadcast_to(enc_bias, (B,) + enc_bias.shape[1:])
+        enc_block = T5EncoderBlock(config)
+
+        def enc_stage(stage_params, h, aux_mb):
+            def body(h, p):
+                return enc_block.apply({"params": p}, h, aux_mb["bias"]), None
+
+            h, _ = jax.lax.scan(body, h, stage_params)
+            return h
+
+        x = pipeline_apply(
+            enc_stage, packed["enc_stacked"], x, mesh,
+            num_microbatches=num_microbatches, aux={"bias": enc_bias},
+        )
+        return bb(t5p, lambda m, v_: m.enc_final_ln(v_), x)
+
+    def init_cross_kv_fn(packed, encoder_hidden):
+        # one batched einsum over the layer-stacked EncDecAttention k/v
+        # projections (T5Attention.project_kv per layer, vectorized), cast
+        # exactly as nn.Dense(dtype=cfg.dtype) would
+        dec = packed["dec_stacked"]["EncDecAttention"]
+        B, T_enc = encoder_hidden.shape[:2]
+        L = config.num_decoder_layers
+
+        def proj(kernel):  # [S, L/S, d_model, inner] -> [L, B, T, H, d_kv]
+            w = kernel.reshape(L, config.d_model, -1).astype(dtype)
+            out = jnp.einsum("btd,ldi->lbti", encoder_hidden.astype(dtype), w)
+            out = out.reshape(L, B, T_enc, config.num_heads, config.d_kv)
+            return jax.lax.with_sharding_constraint(out, resident)
+
+        return {"k": proj(dec["k"]["kernel"]), "v": proj(dec["v"]["kernel"])}
+
+    def decode_fn(packed, decoder_input_ids, encoder_mask=None,
+                  decoder_mask=None, cache=None, cache_index=None,
+                  cross_kv=None):
+        t5p = packed["t5"]
+        B, T = decoder_input_ids.shape
+        y = bb(t5p, lambda m, i: m.shared(i).astype(dtype), decoder_input_ids)
+        C = cache["k"].shape[2]
+        q_pos = cache_index + jnp.arange(T)
+        k_pos = jnp.arange(C)
+        causal = jnp.where(
+            k_pos[None, :] <= q_pos[:, None], 0.0, NEG_INF
+        )[None, None]
+        self_bias = (
+            bb(t5p, lambda m, q, k: m.dec_rel_bias(q, k), q_pos, k_pos)
+            + causal
+        )
+        if decoder_mask is not None:
+            self_bias = self_bias + jnp.where(
+                decoder_mask[:, None, None, :] > 0, 0.0, NEG_INF
+            )
+        self_bias = jnp.broadcast_to(self_bias, (B,) + self_bias.shape[1:])
+        cross_bias = jnp.where(
+            encoder_mask[:, None, None, :] > 0, 0.0, NEG_INF
+        ).astype(jnp.float32)
+        dec_block = T5DecoderBlock(config)
+
+        def stage_fn(stage_params, h, aux_mb, cache_mb, static_mb, idx):
+            def body(h, xs):
+                p, c_mb, x_mb = xs
+                h, new_kv = dec_block.apply(
+                    {"params": p}, h, aux_mb["sb"], aux_mb["cb"],
+                    cache_kv=c_mb, cache_index=idx,
+                    cross_kv=(x_mb["k"], x_mb["v"]),
+                )
+                return h, new_kv
+
+            h, new_kvs = jax.lax.scan(
+                body, h, (stage_params, cache_mb, static_mb)
+            )
+            return h, new_kvs
+
+        h, new_cache = pipeline_apply_cached(
+            stage_fn, packed["dec_stacked"], y, cache, cache_index, mesh,
+            num_microbatches=num_microbatches,
+            aux={"sb": self_bias, "cb": cross_bias}, static_cache=cross_kv,
+        )
+        h = bb(t5p, lambda m, v_: m.dec_final_ln(v_), h)
+        logits = bb(t5p, T5Model.logits, h)
+        values = v_head.apply({"params": packed["v_head"]}, h)[..., 0]
+        return {"logits": logits, "values": values, "cache": new_cache}
+
+    return encode_fn, decode_fn, init_cross_kv_fn

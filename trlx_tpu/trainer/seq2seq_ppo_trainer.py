@@ -69,10 +69,12 @@ class Seq2SeqPPOTrainer(PPOTrainer):
         return False
 
     def _validate_pp_mesh(self, config, train) -> None:
-        # pp for seq2seq (round 3): BOTH trunk stacks pipeline in the
-        # update's forwards (`pp_runner.pp_t5_forward`); the compiled
-        # sampler stays GSPMD (params replicated over pp during rollouts —
-        # encoder-cached decode has no stage-resident layout yet)
+        # pp for seq2seq: BOTH trunk stacks pipeline in the update's
+        # forwards (`pp_runner.pp_t5_forward`), and (round 4) the rollout
+        # sampler is stage-resident too — pipelined encoder, layer-major
+        # decoder KV cache sharded P(pp, batch), cross-attention K/V
+        # precomputed per chunk into the same resident layout
+        # (`make_pp_seq2seq_sampler_fns`)
         from trlx_tpu.models.pp_runner import supports_pp_seq2seq
 
         if not supports_pp_seq2seq(self.model_config):
@@ -136,6 +138,38 @@ class Seq2SeqPPOTrainer(PPOTrainer):
         )["params"]
 
     def _make_sampler(self):
+        if self.pp_stages > 1:
+            from trlx_tpu.models.pp_runner import (
+                make_pp_seq2seq_sampler_fns,
+                pp_t5_init_cache,
+                pp_t5_stack_sampler_params,
+            )
+
+            enc_fn, dec_fn, xkv_fn = make_pp_seq2seq_sampler_fns(
+                self.model_config, self.mesh, self.pp_microbatches
+            )
+            inner = make_seq2seq_sampler(
+                enc_fn,
+                dec_fn,
+                xkv_fn,
+                functools.partial(pp_t5_init_cache, self.model_config),
+                self.gen_config,
+                with_values=True,
+                # residency constraints live inside the pp fns (the
+                # schedule's shard_map out_specs re-pin every step)
+                cache_sharding=None,
+            )
+
+            def sampler(params, prompt_ids, prompt_mask, rng):
+                # stack both stacks' blocks ONCE per invocation, not per
+                # decoded token inside the sampler's scan
+                packed = pp_t5_stack_sampler_params(
+                    self.model_config, self.mesh, params
+                )
+                return inner(packed, prompt_ids, prompt_mask, rng)
+
+            return sampler
+
         model = self.model
         return make_seq2seq_sampler(
             lambda p, ids, mask: model.apply(
