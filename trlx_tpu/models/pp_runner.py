@@ -383,43 +383,32 @@ def supports_pp_seq2seq(model_config) -> bool:
     return isinstance(model_config, T5Config)
 
 
-def pp_t5_forward(
+def _pp_t5_encode(
     config,
-    backbone_params,  # T5Model params ("t5" subtree)
-    input_ids: jax.Array,  # [B, S_enc]
-    attention_mask: jax.Array,  # [B, S_enc]
-    decoder_input_ids: jax.Array,  # [B, T]
-    decoder_attention_mask: jax.Array,  # [B, T]
+    t5_params,
+    input_ids,
+    attention_mask,
     mesh: Mesh,
-    num_microbatches: int = 2,
+    num_microbatches: int,
+    enc_stacked=None,
+    virtual_stages: int = 1,
 ):
-    """Teacher-forced enc→dec forward with BOTH stacks' blocks pipelined
-    over pp (two schedules back to back), numerically identical to
-    ``T5Model.__call__`` (`models/t5.py:431-448` — the fork's policy model,
-    `ppo_models.py:607-655`). Embeddings, the learned rel-pos bias tables,
-    final LayerNorms, and the LM head run replicated over pp; each stack's
-    shared bias tensor is computed once outside the schedule and rides the
-    aux tree (batch-leading), so gradient flows to the rel-pos embeddings
-    through aux. The encoder output rides the decoder schedule's aux the
-    same way (every device holds its batch shard)."""
-    from trlx_tpu.models.t5 import T5DecoderBlock, T5EncoderBlock, T5Model
+    """Pipelined encoder pass (embed → rel-pos bias + mask → schedule →
+    final LN), numerically identical to ``T5Model.encode``. ONE definition
+    shared by the train forward (`pp_t5_forward`) and the rollout sampler
+    (`make_pp_seq2seq_sampler_fns`) — hand-synced copies of a schedule
+    invite silent rollout-vs-update divergence. ``enc_stacked`` lets the
+    sampler pass blocks pre-stacked once per invocation."""
+    from trlx_tpu.models.t5 import T5EncoderBlock, T5Model
     from trlx_tpu.ops.attention import NEG_INF
 
-    S = mesh.shape["pp"]
-    L_enc, L_dec = config.num_layers, config.num_decoder_layers
-    if L_enc % S or L_dec % S:
-        raise ValueError(
-            f"num_layers={L_enc} and num_decoder_layers={L_dec} must both "
-            f"divide into pp={S} stages"
-        )
     backbone = T5Model(config)
     dtype = jnp.dtype(config.dtype)
     B, T_enc = input_ids.shape
 
     def bb(fn, *args):
-        return backbone.apply({"params": backbone_params}, *args, method=fn)
+        return backbone.apply({"params": t5_params}, *args, method=fn)
 
-    # --- encoder stack (bias construction mirrors T5Model.encode) ---
     x = bb(lambda m, i: m.shared(i).astype(dtype), input_ids)
     pos = jnp.arange(T_enc)
     enc_bias = bb(lambda m, q, k: m.enc_rel_bias(q, k), pos, pos)
@@ -427,12 +416,12 @@ def pp_t5_forward(
         enc_bias = enc_bias + jnp.where(
             attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
         )
-    enc_bias = jnp.broadcast_to(
-        enc_bias, (B,) + enc_bias.shape[1:]
-    )
-    enc_stacked = _stack_stages(
-        [backbone_params[f"enc_{i}"] for i in range(L_enc)], S
-    )
+    enc_bias = jnp.broadcast_to(enc_bias, (B,) + enc_bias.shape[1:])
+    if enc_stacked is None:
+        enc_stacked = _stack_stages(
+            [t5_params[f"enc_{i}"] for i in range(config.num_layers)],
+            mesh.shape["pp"], virtual_stages,
+        )
     enc_block = T5EncoderBlock(config)
 
     def enc_stage(stage_params, h, aux_mb):
@@ -445,8 +434,60 @@ def pp_t5_forward(
     x = pipeline_apply(
         enc_stage, enc_stacked, x, mesh,
         num_microbatches=num_microbatches, aux={"bias": enc_bias},
+        virtual_stages=virtual_stages,
     )
-    encoder_hidden = bb(lambda m, v_: m.enc_final_ln(v_), x)
+    return bb(lambda m, v_: m.enc_final_ln(v_), x)
+
+
+def pp_t5_forward(
+    config,
+    backbone_params,  # T5Model params ("t5" subtree)
+    input_ids: jax.Array,  # [B, S_enc]
+    attention_mask: jax.Array,  # [B, S_enc]
+    decoder_input_ids: jax.Array,  # [B, T]
+    decoder_attention_mask: jax.Array,  # [B, T]
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    virtual_stages: int = 1,
+):
+    """Teacher-forced enc→dec forward with BOTH stacks' blocks pipelined
+    over pp (two schedules back to back), numerically identical to
+    ``T5Model.__call__`` (`models/t5.py:431-448` — the fork's policy model,
+    `ppo_models.py:607-655`). Embeddings, the learned rel-pos bias tables,
+    final LayerNorms, and the LM head run replicated over pp; each stack's
+    shared bias tensor is computed once outside the schedule and rides the
+    aux tree (batch-leading), so gradient flows to the rel-pos embeddings
+    through aux. The encoder output rides the decoder schedule's aux the
+    same way (every device holds its batch shard).
+
+    ``virtual_stages > 1`` (round 4): both stacks run the interleaved
+    schedule — each device holds v round-robin layer chunks per stack, the
+    fill/drain bubble shrinks ~v× per stack (the seq2seq path pays TWO
+    schedules per forward, so the win applies twice)."""
+    from trlx_tpu.models.t5 import T5DecoderBlock, T5EncoderBlock, T5Model
+    from trlx_tpu.ops.attention import NEG_INF
+
+    S = mesh.shape["pp"]
+    v = virtual_stages
+    L_enc, L_dec = config.num_layers, config.num_decoder_layers
+    if L_enc % (S * v) or L_dec % (S * v):
+        raise ValueError(
+            f"num_layers={L_enc} and num_decoder_layers={L_dec} must both "
+            f"divide into pp={S} stages x {v} virtual"
+        )
+    backbone = T5Model(config)
+    dtype = jnp.dtype(config.dtype)
+    B, T_enc = input_ids.shape
+
+    def bb(fn, *args):
+        return backbone.apply({"params": backbone_params}, *args, method=fn)
+
+    # --- encoder stack: ONE pipelined-encoder definition shared with the
+    # rollout sampler (`_pp_t5_encode`) ---
+    encoder_hidden = _pp_t5_encode(
+        config, backbone_params, input_ids, attention_mask, mesh,
+        num_microbatches, virtual_stages=v,
+    )
 
     # --- decoder stack (bias construction mirrors T5Model.decode) ---
     T = decoder_input_ids.shape[1]
@@ -469,7 +510,7 @@ def pp_t5_forward(
     else:  # unmasked cross-attention, as T5Model.decode's None path
         cross_bias = jnp.zeros((B, 1, 1, T_enc), jnp.float32)
     dec_stacked = _stack_stages(
-        [backbone_params[f"dec_{i}"] for i in range(L_dec)], S
+        [backbone_params[f"dec_{i}"] for i in range(L_dec)], S, v
     )
     dec_block = T5DecoderBlock(config)
 
@@ -488,6 +529,7 @@ def pp_t5_forward(
         dec_stage, dec_stacked, y, mesh,
         num_microbatches=num_microbatches,
         aux={"sb": self_bias, "cb": cross_bias, "eh": encoder_hidden},
+        virtual_stages=v,
     )
     hidden = bb(lambda m, v_: m.dec_final_ln(v_), y)
     logits = bb(T5Model.logits, hidden)
@@ -503,6 +545,7 @@ def pp_t5_response_forward(
     decoder_attention_mask,
     mesh: Mesh,
     num_microbatches: int = 2,
+    virtual_stages: int = 1,
 ):
     """(logits, values) — the seq2seq PPO update's policy forward with the
     trunk stacks pipelined; the value head reads decoder hidden states
@@ -510,6 +553,7 @@ def pp_t5_response_forward(
     out = pp_t5_forward(
         config, params["t5"], input_ids, attention_mask,
         decoder_input_ids, decoder_attention_mask, mesh, num_microbatches,
+        virtual_stages=virtual_stages,
     )
     v_head = MLPHead(
         config.d_model, 1, dtype=config.dtype, param_dtype=config.param_dtype
@@ -527,12 +571,14 @@ def pp_t5_ref_logits(
     decoder_attention_mask,
     mesh: Mesh,
     num_microbatches: int = 2,
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Frozen-reference logits with the trunk stacks pipelined (the fork
     uses a full frozen copy for T5 — `ppo_orchestrator.py:41-43`)."""
     return pp_t5_forward(
         config, ref_params, input_ids, attention_mask,
         decoder_input_ids, decoder_attention_mask, mesh, num_microbatches,
+        virtual_stages=virtual_stages,
     )["logits"]
 
 
@@ -847,7 +893,7 @@ def make_pp_seq2seq_sampler_fns(config, mesh: Mesh, num_microbatches: int = 2):
     ``tests/test_pp_integration.py``)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from trlx_tpu.models.t5 import T5DecoderBlock, T5EncoderBlock, T5Model
+    from trlx_tpu.models.t5 import T5DecoderBlock, T5Model
     from trlx_tpu.ops.attention import NEG_INF
     from trlx_tpu.parallel.mesh import BATCH_AXES
     from trlx_tpu.parallel.pipeline import pipeline_apply_cached
@@ -863,29 +909,10 @@ def make_pp_seq2seq_sampler_fns(config, mesh: Mesh, num_microbatches: int = 2):
         return backbone.apply({"params": t5_params}, *args, method=fn)
 
     def encode_fn(packed, input_ids, attention_mask):
-        t5p = packed["t5"]
-        B, T_enc = input_ids.shape
-        x = bb(t5p, lambda m, i: m.shared(i).astype(dtype), input_ids)
-        pos = jnp.arange(T_enc)
-        enc_bias = bb(t5p, lambda m, q, k: m.enc_rel_bias(q, k), pos, pos)
-        enc_bias = enc_bias + jnp.where(
-            attention_mask[:, None, None, :] > 0, 0.0, NEG_INF
+        return _pp_t5_encode(
+            config, packed["t5"], input_ids, attention_mask, mesh,
+            num_microbatches, enc_stacked=packed["enc_stacked"],
         )
-        enc_bias = jnp.broadcast_to(enc_bias, (B,) + enc_bias.shape[1:])
-        enc_block = T5EncoderBlock(config)
-
-        def enc_stage(stage_params, h, aux_mb):
-            def body(h, p):
-                return enc_block.apply({"params": p}, h, aux_mb["bias"]), None
-
-            h, _ = jax.lax.scan(body, h, stage_params)
-            return h
-
-        x = pipeline_apply(
-            enc_stage, packed["enc_stacked"], x, mesh,
-            num_microbatches=num_microbatches, aux={"bias": enc_bias},
-        )
-        return bb(t5p, lambda m, v_: m.enc_final_ln(v_), x)
 
     def init_cross_kv_fn(packed, encoder_hidden):
         # one batched einsum over the layer-stacked EncDecAttention k/v
@@ -894,9 +921,15 @@ def make_pp_seq2seq_sampler_fns(config, mesh: Mesh, num_microbatches: int = 2):
         dec = packed["dec_stacked"]["EncDecAttention"]
         B, T_enc = encoder_hidden.shape[:2]
         L = config.num_decoder_layers
+        layer_sh = NamedSharding(mesh, PartitionSpec("pp"))
 
         def proj(kernel):  # [S, L/S, d_model, inner] -> [L, B, T, H, d_kv]
             w = kernel.reshape(L, config.d_model, -1).astype(dtype)
+            # keep the layer dim sharded over pp through the reshape so
+            # GSPMD partitions the einsum per stage (each device projects
+            # only its own L/S layers) instead of all-gathering the
+            # kernels and computing all L layers replicated
+            w = jax.lax.with_sharding_constraint(w, layer_sh)
             out = jnp.einsum("btd,ldi->lbti", encoder_hidden.astype(dtype), w)
             out = out.reshape(L, B, T_enc, config.num_heads, config.d_kv)
             return jax.lax.with_sharding_constraint(out, resident)

@@ -84,15 +84,16 @@ class Seq2SeqPPOTrainer(PPOTrainer):
             )
         L_enc = self.model_config.num_layers
         L_dec = self.model_config.num_decoder_layers
-        if L_enc % self.pp_stages or L_dec % self.pp_stages:
+        v = train.pp_virtual_stages
+        # interleaved schedule (round 4): both stacks accept v > 1 — each
+        # device holds v round-robin layer chunks per stack; the train
+        # forwards pay two schedules, so the ~v× bubble shrink applies
+        # twice. Decode keeps v=1 (contiguous stage-resident caches).
+        if L_enc % (self.pp_stages * v) or L_dec % (self.pp_stages * v):
             raise ValueError(
                 f"num_layers={L_enc} and num_decoder_layers={L_dec} must "
-                f"both divide into pp={self.pp_stages} stages"
-            )
-        if train.pp_virtual_stages > 1:
-            raise NotImplementedError(
-                "the interleaved schedule is not wired for the seq2seq "
-                "stacks yet; drop pp_virtual_stages"
+                f"both divide into pp={self.pp_stages} stages x "
+                f"{v} virtual"
             )
 
     def _check_response_budget(self, train) -> None:
@@ -204,6 +205,7 @@ class Seq2SeqPPOTrainer(PPOTrainer):
             logits, values = pp_t5_response_forward(
                 self.model_config, params, mb.query_tokens, mb.query_mask,
                 dec_ids, dec_mask, self.mesh, self.pp_microbatches,
+                virtual_stages=self.pp_virtual_stages,
             )
             out = {"logits": logits, "values": values}
         else:
@@ -236,6 +238,7 @@ class Seq2SeqPPOTrainer(PPOTrainer):
             logits = pp_t5_ref_logits(
                 self.model_config, ref_params, q_ids, q_mask,
                 dec_ids, dec_mask, self.mesh, self.pp_microbatches,
+                virtual_stages=self.pp_virtual_stages,
             )
             return logprobs_from_logits(logits, r_ids)
         out = self.backbone.apply(
