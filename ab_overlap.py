@@ -43,27 +43,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bench_collect_audit import bench_config, force
-from trlx_tpu.utils.loading import get_orchestrator, get_pipeline, get_trainer
+from bench_collect_audit import (
+    bench_reward_fn as reward_fn, force, make_bench_workload,
+)
+from trlx_tpu.utils.loading import get_orchestrator
 
 
 def main():
-    config = bench_config()
-    rng = np.random.default_rng(0)
-    prompts = [list(rng.integers(100, 40000, size=rng.integers(4, 33)))
-               for _ in range(512)]
-
-    def reward_fn(samples, queries, response_gt=None):
-        return [len(set(s)) / max(len(s), 1) for s in samples]
-
-    trainer = get_trainer(config.train.trainer)(config, reward_fn=reward_fn)
-    pipeline = get_pipeline(config.train.pipeline)(
-        prompts, config.train.seq_length
-    )
-    orch = get_orchestrator(config.train.orchestrator)(
-        trainer, pipeline, reward_fn=reward_fn,
-        chunk_size=config.method.chunk_size,
-    )
+    config, trainer, pipeline, orch = make_bench_workload()
     orch_chunked = get_orchestrator(config.train.orchestrator)(
         trainer, pipeline, reward_fn=reward_fn, chunk_size=64
     )

@@ -75,6 +75,37 @@ def force(x):
     return float(jnp.ravel(x)[0])
 
 
+def bench_reward_fn(samples, queries, response_gt=None):
+    """The bench workload's cheap host reward (one definition for the
+    audit + every ab_* script — a drifted copy would silently measure a
+    different workload)."""
+    return [len(set(s)) / max(len(s), 1) for s in samples]
+
+
+def make_bench_workload(chunk_size=None):
+    """(trainer, pipeline, orchestrator) at the bench shape — shared setup
+    for the A/B scripts."""
+    from trlx_tpu.utils.loading import (
+        get_orchestrator, get_pipeline, get_trainer,
+    )
+
+    config = bench_config()
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(100, 40000, size=rng.integers(4, 33)))
+               for _ in range(512)]
+    trainer = get_trainer(config.train.trainer)(
+        config, reward_fn=bench_reward_fn
+    )
+    pipeline = get_pipeline(config.train.pipeline)(
+        prompts, config.train.seq_length
+    )
+    orch = get_orchestrator(config.train.orchestrator)(
+        trainer, pipeline, reward_fn=bench_reward_fn,
+        chunk_size=chunk_size or config.method.chunk_size,
+    )
+    return config, trainer, pipeline, orch
+
+
 def main():
     config = bench_config()
     rng = np.random.default_rng(0)
