@@ -133,11 +133,36 @@ def main(coordinator: str, num_processes: int, process_id: int) -> None:
     loss = float(stats["losses/total_loss"])
     assert np.isfinite(loss), loss
 
+    # pipeline-parallel leg (round 4): the GPipe schedule's ppermute hops
+    # must ride the cross-PROCESS transport, not just intra-process ICI.
+    # One device from EACH process forms a pp=2 mesh (the canonical
+    # dp-major mesh would place pp pairs within a process), a 2-stage
+    # pipeline runs a stacked linear stage, and the result must equal the
+    # local composition of both stages.
+    from trlx_tpu.parallel.mesh import make_mesh
+    from trlx_tpu.parallel.pipeline import pipeline_apply
+
+    d0, d1 = jax.devices()[0], jax.devices()[n_local]
+    assert d0.process_index != d1.process_index, (d0, d1)
+    pp_mesh = make_mesh({"dp": 1, "pp": 2}, devices=[d0, d1])
+    stage_w = jnp.stack(
+        [jnp.eye(16) * 2.0, jnp.eye(16) + 0.5]
+    )  # [S=2, 16, 16]
+    xb = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+
+    pp_out = pipeline_apply(
+        lambda p, h: h @ p, stage_w, xb, pp_mesh, num_microbatches=2
+    )
+    expected = np.asarray(xb @ stage_w[0] @ stage_w[1])
+    got = np.asarray(pp_out.addressable_shards[0].data)
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
     barrier("done")
     if is_main_process():
         print(
             f"mp_smoke ok: procs={num_processes} devices={n_global} "
-            f"mesh dp={dp} fsdp={fsdp} tp={tp} loss={loss:.4f}",
+            f"mesh dp={dp} fsdp={fsdp} tp={tp} "
+            f"(+cross-process pp=2 ppermute) loss={loss:.4f}",
             flush=True,
         )
 
