@@ -9,20 +9,24 @@ import numpy as np
 import pytest
 
 
-def _config(tmp_path, total_steps, resume=False):
+def _config(tmp_path, total_steps, resume=False, n_layer=1,
+            num_layers_unfrozen=-1, adam_moment_dtype="float32"):
     from trlx_tpu.data.configs import TRLConfig
 
     return TRLConfig.from_dict(
         {
-            "model": {"model_type": "gpt2", "model_arch": {
+            "model": {"model_type": "gpt2",
+                      "num_layers_unfrozen": num_layers_unfrozen,
+                      "model_arch": {
                 "vocab_size": 32, "n_positions": 16, "n_embd": 16,
-                "n_layer": 1, "n_head": 2}},
+                "n_layer": n_layer, "n_head": 2}},
             "train": {
                 "seq_length": 4, "batch_size": 8, "epochs": 8,
                 "total_steps": total_steps, "eval_interval": 10000,
                 "checkpoint_interval": 100000,
                 "checkpoint_dir": str(tmp_path / "ckpt"),
                 "resume_from_checkpoint": resume,
+                "adam_moment_dtype": adam_moment_dtype,
                 "mesh": {"dp": -1, "fsdp": 1, "tp": 1}, "dtype": "float32",
             },
             "method": {
@@ -69,6 +73,39 @@ def test_resume_continues_from_saved_step(tmp_path):
     loaded = jax.tree_util.tree_leaves(t3.state.params)
     for a, b in zip(
         jax.tree_util.tree_leaves(t2.state.params), loaded
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_with_masked_and_bf16_moment_opt_state(tmp_path):
+    """Round-4 optimizer-state shapes survive the checkpoint round trip:
+    frozen bottom layers (optax.masked — frozen leaves carry NO moment
+    arrays, so the saved composite has fewer leaves) and bf16 moments
+    (reduced-dtype arrays restore at their stored dtype)."""
+    import jax
+    import jax.numpy as jnp
+
+    kw = dict(n_layer=4, num_layers_unfrozen=2,
+              adam_moment_dtype="bfloat16")
+    t1 = _train(_config(tmp_path, total_steps=2, **kw))
+    assert int(t1.state.step) == 2
+
+    t2 = _train(_config(tmp_path, total_steps=4, resume=True, **kw))
+    assert int(t2.state.step) == 4
+    moments = [
+        l for l in jax.tree_util.tree_leaves(t2.state.opt_state)
+        if hasattr(l, "ndim") and l.ndim > 0
+    ]
+    n_trainable = sum(jax.tree_util.tree_leaves(t2.trainable_mask))
+    assert len(moments) == 2 * n_trainable  # masked layout survived resume
+    assert all(m.dtype == jnp.bfloat16 for m in moments)
+
+    # a finished-run resume round-trips the whole state bit-exactly
+    t3 = _train(_config(tmp_path, total_steps=4, resume=True, **kw))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(t2.state)),
+        jax.tree_util.tree_leaves(jax.device_get(t3.state)),
+        strict=True,  # a structure-changing restore must fail, not truncate
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
