@@ -184,3 +184,41 @@ def test_backward_is_pruned_below_branch_point():
     full = train_step_flops(-1)
     frozen = train_step_flops(2)
     assert frozen < 0.8 * full, (frozen, full)
+
+
+def test_seq2seq_refuses_positive_unfrozen():
+    """The freezing mask keys on causal block names (`h_<i>`); T5's
+    `enc_<i>`/`dec_<i>` leaves would all silently stay trainable. The
+    seq2seq trainer refuses a positive num_layers_unfrozen loudly (the
+    reference trains the full T5 and full-copies the KL ref)."""
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "t5",
+                "num_layers_unfrozen": 2,
+                "model_arch": {
+                    "vocab_size": 32, "d_model": 32, "d_kv": 8, "d_ff": 64,
+                    "num_layers": 2, "num_decoder_layers": 2, "num_heads": 4,
+                },
+            },
+            "train": {
+                "seq_length": 8, "batch_size": 8, "epochs": 1,
+                "total_steps": 4, "eval_interval": 1000,
+                "checkpoint_interval": 100000, "trainer": "Seq2SeqPPOTrainer",
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1}, "dtype": "float32",
+            },
+            "method": {
+                "name": "PPOConfig", "num_rollouts": 16, "chunk_size": 16,
+                "ppo_epochs": 1,
+                "gen_kwargs": {"max_new_tokens": 4, "do_sample": True,
+                               "eos_token_id": 1, "pad_token_id": 0,
+                               "decoder_start_token_id": 0},
+            },
+        }
+    )
+    with pytest.raises(NotImplementedError, match="seq2seq"):
+        get_trainer("Seq2SeqPPOTrainer")(config, reward_fn=lambda **kw: [0.0])

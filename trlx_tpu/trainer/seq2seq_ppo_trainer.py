@@ -116,6 +116,19 @@ class Seq2SeqPPOTrainer(PPOTrainer):
     def _setup_model(self):
         from trlx_tpu.models.registry import get_model_family
 
+        if self.config.model.num_layers_unfrozen > 0:
+            # The reference never freezes T5 (`ppo_config.yml:5` uses 0 and
+            # upstream's freeze_bottom_causal_layers expects a causal
+            # `transformer.h` stack); our mask keys on causal block names
+            # (`h_<i>`), so a positive value here would silently train the
+            # FULL model while claiming to freeze — refuse instead.
+            raise NotImplementedError(
+                "num_layers_unfrozen > 0 is not defined for the seq2seq "
+                "(encoder-decoder) family — the reference trains the full "
+                "T5 and uses a full frozen copy as the KL reference "
+                "(`ppo_orchestrator.py:41-43`); set num_layers_unfrozen "
+                "to 0 or -1"
+            )
         self.family = get_model_family("t5")
         self.model_config, init_params = get_t5_arch(self.config)
         self.model = T5WithValueHead(self.model_config)
