@@ -222,3 +222,84 @@ def test_seq2seq_refuses_positive_unfrozen():
     )
     with pytest.raises(NotImplementedError, match="seq2seq"):
         get_trainer("Seq2SeqPPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+
+
+def test_ilql_frozen_leaves_bit_identical():
+    """The pruned-backward + masked-moment freezing covers the ILQL
+    trainer too (reference `ilql_models.py:217-225` freezes wte/wpe +
+    bottom blocks via requires_grad=False): frozen leaves stay bit
+    identical through offline updates and carry no moment arrays."""
+    os.environ["WANDB_DISABLED"] = "1"
+    import jax
+
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    def make_config():
+        return TRLConfig.from_dict(
+            {
+                "model": {
+                    "model_type": "gpt2",
+                    "num_layers_unfrozen": 2,
+                    "model_arch": {
+                        "vocab_size": 32, "n_positions": 32, "n_embd": 16,
+                        "n_layer": 4, "n_head": 2,
+                    },
+                },
+                "train": {
+                    "seq_length": 8, "batch_size": 8, "epochs": 1,
+                    "total_steps": 4, "eval_interval": 1000,
+                    "checkpoint_interval": 100000, "trainer": "ILQLTrainer",
+                    "orchestrator": "OfflineOrchestrator",
+                    "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                    "dtype": "float32",
+                },
+                "method": {
+                    "name": "ILQLConfig",
+                    "gen_kwargs": {"max_new_tokens": 4, "do_sample": True,
+                                   "eos_token_id": 30, "pad_token_id": 31},
+                },
+            }
+        )
+
+    rng = np.random.default_rng(0)
+    samples = [
+        ([int(x) for x in rng.integers(1, 30, size=8)], 4) for _ in range(32)
+    ]
+    rewards = [float(s[0][-1] % 3) for s in samples]
+
+    # capture the pre-training params directly, then learn() on the same
+    # trainer (api.train would build its own; the direct path lets us
+    # snapshot init without relying on seed-identical re-construction)
+    trainer = get_trainer("ILQLTrainer")(make_config())
+    init = jax.device_get(trainer.state.params)
+    n_params = len(jax.tree_util.tree_leaves(init))
+    n_trainable = sum(jax.tree_util.tree_leaves(trainer.trainable_mask))
+    assert n_trainable < n_params  # the mask really froze something
+
+    from trlx_tpu.orchestrator.offline_orchestrator import OfflineOrchestrator
+
+    OfflineOrchestrator(trainer).make_experience(samples, rewards)
+    trainer.learn()
+    after = jax.device_get(trainer.state.params)
+    flat_mask = dict(jax.tree_util.tree_leaves_with_path(trainer.trainable_mask))
+    flat_init = dict(jax.tree_util.tree_leaves_with_path(init))
+    moved_frozen = [
+        jax.tree_util.keystr(path)
+        for path, leaf in jax.tree_util.tree_leaves_with_path(after)
+        if not flat_mask[path]
+        and not np.array_equal(np.asarray(leaf), np.asarray(flat_init[path]))
+    ]
+    assert not moved_frozen, moved_frozen
+    # and the trainable slice did move
+    assert any(
+        flat_mask[path]
+        and not np.array_equal(np.asarray(leaf), np.asarray(flat_init[path]))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(after)
+    )
+    moments = [
+        l for l in jax.tree_util.tree_leaves(trainer.state.opt_state)
+        if hasattr(l, "ndim") and l.ndim > 0
+    ]
+    assert len(moments) == 2 * n_trainable
