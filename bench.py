@@ -238,10 +238,12 @@ def main():
                     "n_embd": 768,
                     "n_layer": 12,
                     "n_head": 12,
-                    # int8 rollout KV cache: measured 1.10x on the sampler
-                    # (interleaved A/B, ab_int8_kv.py) — decode is
-                    # HBM-bound and the cache is its dominant traffic
-                    "kv_cache_dtype": "int8",
+                    # "auto" resolves to int8 at this cache shape (cap
+                    # 112 <= INT8_KV_MAX_CAPACITY): measured 1.10x on the
+                    # sampler (interleaved A/B, ab_int8_kv.py) — decode is
+                    # HBM-bound and the cache is its dominant traffic.
+                    # bf16 beyond the measured long-context crossover.
+                    "kv_cache_dtype": "auto",
                 },
             },
             "train": {
@@ -374,10 +376,15 @@ def main():
     if hbm_peak:
         # per-chip traffic: weights replicate over dp (each chip streams
         # them in full), cache/logits follow the chip's batch shard
+        from trlx_tpu.models.gpt2 import resolve_kv_cache_dtype
+
+        kv_dtype = resolve_kv_cache_dtype(
+            arch.get("kv_cache_dtype", "bfloat16"), Q + R
+        )
         per_chip_bytes = _collect_bytes(
             d=arch["n_embd"], V=arch["vocab_size"], L=arch["n_layer"],
             Q=Q, R=R, B=B // n_chips,
-            kv_cache_bytes=1 if arch.get("kv_cache_dtype") == "int8" else 2,
+            kv_cache_bytes=1 if kv_dtype == "int8" else 2,
         )
         gbps = n_phases * per_chip_bytes / times["collect"] / 1e9
         extras["collect_phase_hbm_gbps"] = round(gbps, 1)
