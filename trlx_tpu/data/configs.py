@@ -133,6 +133,16 @@ class TrainConfig:
     # keeps the plain stage-major schedule (the stage-resident KV layout
     # is contiguous). See parallel/pipeline.py::pipeline_span_layer_units.
     pp_virtual_stages: int = 1
+    # Rematerialized pipeline backward for the TRAIN schedule (the memory
+    # half of 1F1B — the bubble spans of GPipe-fwd+bwd and 1F1B are equal):
+    # the forward saves only each stage's input per microbatch and the
+    # custom backward recomputes stages under jax.vjp on the mirrored
+    # schedule, instead of autodiff saving every tick's layer internals.
+    # Cuts the update's peak activation memory (measured via XLA
+    # memory_analysis in tests/test_pipeline_parallel.py); costs one extra
+    # stage forward per backward (the standard remat trade). v=1 only;
+    # exact grad parity vs the autodiffed schedule is pinned in tests.
+    pp_remat: bool = False
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     # Serve the rollout phase (sampler + frozen-ref scoring) a one-time

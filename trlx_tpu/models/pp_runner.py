@@ -179,6 +179,31 @@ def _stage_body(kit: _PPKit, block, aux_mb, causal: bool, cached: bool):
     return body
 
 
+def _run_schedule(stage_fn, stage_tree, x, mesh, num_microbatches, aux,
+                  virtual_stages, remat):
+    """One dispatch point for the plain train schedule: autodiffed GPipe /
+    interleaved (v > 1) or the rematerialized backward. Centralizes the
+    remat-vs-v guard so every caller fails the same way."""
+    if remat:
+        if virtual_stages > 1:
+            raise NotImplementedError(
+                "pp_remat runs the v=1 schedule; drop pp_virtual_stages "
+                "or pp_remat (the two memory/bubble trades do not "
+                "compose yet)"
+            )
+        from trlx_tpu.parallel.pipeline import pipeline_apply_remat
+
+        return pipeline_apply_remat(
+            stage_fn, stage_tree, x, mesh,
+            num_microbatches=num_microbatches, aux=aux,
+        )
+    return pipeline_apply(
+        stage_fn, stage_tree, x, mesh,
+        num_microbatches=num_microbatches, aux=aux,
+        virtual_stages=virtual_stages,
+    )
+
+
 def pp_hidden_forward(
     config,
     backbone_params,
@@ -189,6 +214,7 @@ def pp_hidden_forward(
     virtual_stages: int = 1,
     capture_layer: int = None,
     capture_only: bool = False,
+    remat: bool = False,
 ) -> jax.Array:
     """Full-sequence causal trunk forward (embed -> pp blocks -> ln_f),
     numerically identical to the family backbone's ``__call__`` with
@@ -263,11 +289,20 @@ def pp_hidden_forward(
         capture_stage = capture_layer // chunk
 
     stage_tree = (stacked, flags) if kit.windowed else stacked
-    res = pipeline_apply(
-        stage_fn, stage_tree, x, mesh,
-        num_microbatches=num_microbatches, aux=aux, virtual_stages=v,
-        capture_stage=capture_stage, capture_only=capture_only,
-    )
+    if capture_stage is not None:
+        if remat:
+            raise NotImplementedError(
+                "pp_remat has no hydra capture; use the autodiffed schedule"
+            )
+        res = pipeline_apply(
+            stage_fn, stage_tree, x, mesh,
+            num_microbatches=num_microbatches, aux=aux, virtual_stages=v,
+            capture_stage=capture_stage, capture_only=capture_only,
+        )
+    else:
+        res = _run_schedule(
+            stage_fn, stage_tree, x, mesh, num_microbatches, aux, v, remat
+        )
     if capture_stage is None:
         return _ln_f(kit, config, backbone_params, res)
     h, caps = res
@@ -287,13 +322,17 @@ def pp_response_forward(
     mesh: Mesh,
     num_microbatches: int = 2,
     virtual_stages: int = 1,
+    remat: bool = False,
 ):
     """pp counterpart of ``CausalLMWithValueHead.response_forward``:
-    (logits, values) over the response-predicting positions Q-1..Q+R-2."""
+    (logits, values) over the response-predicting positions Q-1..Q+R-2.
+    ``remat=True`` routes the trunk through the rematerialized-backward
+    schedule (`pipeline_apply_remat`) — stage inputs are the only saved
+    residuals, cutting the update's peak activation memory."""
     kit = _pp_kit(config)
     h = pp_hidden_forward(
         config, params["transformer"], input_ids, attention_mask,
-        mesh, num_microbatches, virtual_stages,
+        mesh, num_microbatches, virtual_stages, remat=remat,
     )
     hs = h[:, query_length - 1 : -1]
     v_head = MLPHead(
@@ -392,6 +431,7 @@ def _pp_t5_encode(
     num_microbatches: int,
     enc_stacked=None,
     virtual_stages: int = 1,
+    remat: bool = False,
 ):
     """Pipelined encoder pass (embed → rel-pos bias + mask → schedule →
     final LN), numerically identical to ``T5Model.encode``. ONE definition
@@ -431,10 +471,9 @@ def _pp_t5_encode(
         h, _ = jax.lax.scan(body, h, stage_params)
         return h
 
-    x = pipeline_apply(
-        enc_stage, enc_stacked, x, mesh,
-        num_microbatches=num_microbatches, aux={"bias": enc_bias},
-        virtual_stages=virtual_stages,
+    x = _run_schedule(
+        enc_stage, enc_stacked, x, mesh, num_microbatches,
+        {"bias": enc_bias}, virtual_stages, remat,
     )
     return bb(lambda m, v_: m.enc_final_ln(v_), x)
 
@@ -449,6 +488,7 @@ def pp_t5_forward(
     mesh: Mesh,
     num_microbatches: int = 2,
     virtual_stages: int = 1,
+    remat: bool = False,
 ):
     """Teacher-forced enc→dec forward with BOTH stacks' blocks pipelined
     over pp (two schedules back to back), numerically identical to
@@ -486,7 +526,7 @@ def pp_t5_forward(
     # rollout sampler (`_pp_t5_encode`) ---
     encoder_hidden = _pp_t5_encode(
         config, backbone_params, input_ids, attention_mask, mesh,
-        num_microbatches, virtual_stages=v,
+        num_microbatches, virtual_stages=v, remat=remat,
     )
 
     # --- decoder stack (bias construction mirrors T5Model.decode) ---
@@ -525,11 +565,9 @@ def pp_t5_forward(
         h, _ = jax.lax.scan(body, h, stage_params)
         return h
 
-    y = pipeline_apply(
-        dec_stage, dec_stacked, y, mesh,
-        num_microbatches=num_microbatches,
-        aux={"sb": self_bias, "cb": cross_bias, "eh": encoder_hidden},
-        virtual_stages=v,
+    y = _run_schedule(
+        dec_stage, dec_stacked, y, mesh, num_microbatches,
+        {"sb": self_bias, "cb": cross_bias, "eh": encoder_hidden}, v, remat,
     )
     hidden = bb(lambda m, v_: m.dec_final_ln(v_), y)
     logits = bb(T5Model.logits, hidden)
@@ -546,6 +584,7 @@ def pp_t5_response_forward(
     mesh: Mesh,
     num_microbatches: int = 2,
     virtual_stages: int = 1,
+    remat: bool = False,
 ):
     """(logits, values) — the seq2seq PPO update's policy forward with the
     trunk stacks pipelined; the value head reads decoder hidden states
@@ -553,7 +592,7 @@ def pp_t5_response_forward(
     out = pp_t5_forward(
         config, params["t5"], input_ids, attention_mask,
         decoder_input_ids, decoder_attention_mask, mesh, num_microbatches,
-        virtual_stages=virtual_stages,
+        virtual_stages=virtual_stages, remat=remat,
     )
     v_head = MLPHead(
         config.d_model, 1, dtype=config.dtype, param_dtype=config.param_dtype
@@ -593,6 +632,7 @@ def pp_ilql_forward(
     num_microbatches: int = 2,
     two_qs: bool = True,
     virtual_stages: int = 1,
+    remat: bool = False,
 ):
     """pp counterpart of ``CausalLMWithILQLHeads.__call__`` (no cache):
     trunk blocks through the GPipe schedule; logits and the Q/V heads run
@@ -603,7 +643,7 @@ def pp_ilql_forward(
     kit = _pp_kit(config)
     h = pp_hidden_forward(
         config, params["transformer"], input_ids, attention_mask,
-        mesh, num_microbatches, virtual_stages,
+        mesh, num_microbatches, virtual_stages, remat=remat,
     )
     logits = _logits(kit, config, params["transformer"], h)
     action_hidden = (

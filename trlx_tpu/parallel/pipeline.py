@@ -130,11 +130,20 @@ def pipeline_apply_cached(
     capture_stage: int = None,
     capture_only: bool = False,
     static_cache=None,
+    capture_all: bool = False,
 ):
     """The pipeline schedule — one implementation for all three uses:
     cache-less train forward (via :func:`pipeline_apply`), rollout decode
     with STAGE-RESIDENT KV caches, and the interleaved train schedule
     (``virtual_stages > 1``, cache-less only).
+
+    ``capture_all=True`` (v=1, cache-less): EVERY device additionally
+    saves the activation entering its own stage for each microbatch and
+    the schedule returns it as a third output shaped ``[S, M, B/M, ...]``
+    sharded ``P(pp, None, batch)`` — the residuals of the rematerialized
+    pipeline backward (:func:`pipeline_apply_remat`), which stores only
+    stage INPUTS instead of letting autodiff save every layer's
+    internals across the whole schedule.
 
     ``static_cache`` (optional): a READ-ONLY stage-resident tree with the
     same layer-major ``[L, B, ...]`` layout and ``P(pp, batch)`` sharding
@@ -178,6 +187,16 @@ def pipeline_apply_cached(
     S = mesh.shape[axis_name]
     M = num_microbatches
     v = virtual_stages
+    if capture_all:
+        if capture_stage is not None or v > 1:
+            raise NotImplementedError(
+                "capture_all (remat residuals) is v=1 and exclusive with "
+                "capture_stage"
+            )
+        if jax.tree_util.tree_leaves(cache):
+            raise NotImplementedError(
+                "capture_all is for the cache-less train schedule"
+            )
     if capture_stage is not None:
         if v > 1:
             raise NotImplementedError(
@@ -245,11 +264,13 @@ def pipeline_apply_cached(
         buf0 = jnp.zeros_like(mbs[0]) + pp_zero
         outs0 = jnp.zeros_like(mbs) + pp_zero
 
+        want_caps = capture_stage is not None or capture_all
+
         def tick(t, carry):
             # caps rides the carry only when a capture is requested — the
             # hot paths (train forward, per-token decode) carry no dead
             # buffer
-            if capture_stage is not None:
+            if want_caps:
                 buf, outs, cache, caps = carry
             else:
                 (buf, outs, cache), caps = carry, None
@@ -276,7 +297,10 @@ def pipeline_apply_cached(
                 chunk_params = params
             m_c = jnp.clip(m, 0, M - 1)
             h_in = jnp.where(is_first, mbs[m_c], buf)
-            if capture_stage is not None:
+            if capture_all:
+                # every device saves its own stage's input (remat residual)
+                caps = jnp.where(active, caps.at[m_c].set(h_in), caps)
+            elif capture_stage is not None:
                 # the activation ENTERING stage k (the hydra branch point)
                 caps = jnp.where(
                     jnp.logical_and(active, idx == capture_stage),
@@ -315,7 +339,7 @@ def pipeline_apply_cached(
             )
             wire = jnp.where(active, h_out, buf * 0.0)
             buf = jax.lax.ppermute(wire, axis_name, perm)
-            if capture_stage is None:
+            if not want_caps:
                 return buf, outs, cache
             return buf, outs, cache, caps
 
@@ -323,7 +347,7 @@ def pipeline_apply_cached(
         if capture_stage is not None and capture_only:
             # last microbatch reaches stage k at tick k + M - 1
             n_ticks = capture_stage + M
-        if capture_stage is None:
+        if not want_caps:
             _, outs, cache = jax.lax.fori_loop(
                 0, n_ticks, tick, (buf0, outs0, cache)
             )
@@ -334,8 +358,12 @@ def pipeline_apply_cached(
             )
         outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
         outs = jax.lax.psum(outs, axis_name)
-        if capture_stage is None:
+        if not want_caps:
             return outs.reshape(x.shape), cache
+        if capture_all:
+            # per-device stage residuals: [1, M, bm, ...] -> global
+            # [S, M, B/M, ...] under P(pp, None, batch)
+            return outs.reshape(x.shape), cache, caps[None]
         caps = jnp.where(idx == capture_stage, caps, jnp.zeros_like(caps))
         caps = jax.lax.psum(caps, axis_name)
         return outs.reshape(x.shape), cache, caps.reshape(x.shape)
@@ -358,11 +386,12 @@ def pipeline_apply_cached(
         lambda _: P(axis_name, batch_axes), cache
     )
     aux_specs = jax.tree_util.tree_map(lambda _: P(batch_axes), aux)
-    out_specs = (
-        (x_spec, cache_specs)
-        if capture_stage is None
-        else (x_spec, cache_specs, x_spec)
-    )
+    if capture_all:
+        out_specs = (x_spec, cache_specs, P(axis_name, None, batch_axes))
+    elif capture_stage is not None:
+        out_specs = (x_spec, cache_specs, x_spec)
+    else:
+        out_specs = (x_spec, cache_specs)
     static_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name, batch_axes), static_cache
     )
@@ -372,3 +401,168 @@ def pipeline_apply_cached(
         in_specs=(param_specs, x_spec, cache_specs, static_specs, P(), aux_specs),
         out_specs=out_specs,
     )(stacked_params, x, cache, static_cache, cache_index, aux)
+
+
+def pipeline_apply_remat(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "pp",
+    num_microbatches: int = 2,
+    batch_axes=("dp", "fsdp"),
+    aux=None,
+) -> jax.Array:
+    """:func:`pipeline_apply` with a REMATERIALIZED, hand-scheduled
+    backward (the memory half of 1F1B — the part that matters; the bubble
+    spans of GPipe-fwd+bwd and 1F1B are equal at 2(S+M-1) ticks).
+
+    Autodiff through the fori_loop schedule saves every tick's stage
+    internals (all L/S layers' activations per microbatch) for the whole
+    span. Here the forward saves ONLY each stage's input activation per
+    microbatch (``capture_all``), and the custom backward re-runs the
+    mirrored schedule: at each reverse tick the active device RECOMPUTES
+    its stage forward from the saved input under ``jax.vjp`` and applies
+    the arriving cotangent — param grads accumulate per stage, activation
+    cotangents hop backward over the inverse ``ppermute`` ring, aux
+    cotangents (shared bias tensors) accumulate across stages via psum.
+    Peak residual memory drops from O(span · per-layer internals) to
+    O(M stage inputs) per device + one stage's recompute working set.
+
+    v=1, cache-less, train-schedule only. Gradient parity vs the
+    autodiffed schedule is pinned in ``tests/test_pipeline_parallel.py``.
+    """
+    S = mesh.shape[axis_name]
+    M = num_microbatches
+    aux_dict = {} if aux is None else aux
+    has_aux = bool(jax.tree_util.tree_leaves(aux_dict))
+    x_dtype = x.dtype  # static metadata only — bwd must not touch outer tracers
+
+    def call_stage(p, h, a):
+        return stage_fn(p, h, a) if has_aux else stage_fn(p, h)
+
+    def fwd_schedule(params, xx, a, capture):
+        def adapted(p, h, aux_m, _cache, _idx):
+            return call_stage(p, h, aux_m), {}
+
+        return pipeline_apply_cached(
+            adapted, params, xx, {}, 0, mesh,
+            axis_name=axis_name, num_microbatches=M,
+            batch_axes=batch_axes, aux=a if has_aux else None,
+            capture_all=capture,
+        )
+
+    @jax.custom_vjp
+    def run(params, xx, a):
+        return fwd_schedule(params, xx, a, capture=False)[0]
+
+    def run_fwd(params, xx, a):
+        out, _, saves = fwd_schedule(params, xx, a, capture=True)
+        return out, (params, saves, a)
+
+    def run_bwd(res, g):
+        params, saves, a = res
+
+        def local_bwd(params, saves, a, g):
+            params = jax.tree_util.tree_map(lambda p: p[0], params)
+            saves = saves[0]  # [M, bm, ...] — this stage's inputs
+            idx = jax.lax.axis_index(axis_name)
+            n = jax.lax.psum(1, axis_name)
+            b = g.shape[0]
+            bm = b // M
+            g_mbs = g.reshape((M, bm) + g.shape[1:]).astype(g.dtype)
+            aux_mbs = jax.tree_util.tree_map(
+                lambda t: t.reshape((M, t.shape[0] // M) + t.shape[1:]), a
+            )
+            inv_perm = [(i, (i - 1) % n) for i in range(n)]
+            pp_zero = (0.0 * idx).astype(g.dtype)
+            buf0 = jnp.zeros_like(g_mbs[0]) + pp_zero
+            dxs0 = jnp.zeros_like(g_mbs) + pp_zero
+            # accumulator inits derive from the data (0*value keeps every
+            # varying-axis annotation: params vary over pp, aux over the
+            # batch axes + pp via the idx marker) — synthesized zeros are
+            # axis-invariant and shard_map rejects the loop carry
+            dp0 = jax.tree_util.tree_map(
+                lambda p: (0.0 * p).astype(
+                    jnp.promote_types(p.dtype, jnp.float32)
+                ),
+                params,
+            )
+            da0 = jax.tree_util.tree_map(
+                lambda t: (0.0 * t).astype(
+                    jnp.promote_types(t.dtype, jnp.float32)
+                )
+                + (0.0 * idx),
+                aux_mbs,
+            )
+
+            def tick(r, carry):
+                buf, dxs, dparams, daux = carry
+                # stage idx handled microbatch m forward at tick m + idx;
+                # its cotangent arrives in mirrored order at r = m + (n-1-idx)
+                m = r - (n - 1 - idx)
+                active = jnp.logical_and(m >= 0, m < M)
+                m_c = jnp.clip(m, 0, M - 1)
+                gbar = jnp.where(idx == n - 1, g_mbs[m_c], buf)
+                aux_m = jax.tree_util.tree_map(lambda t: t[m_c], aux_mbs)
+                h_in = saves[m_c]
+                _, vjp_fn = jax.vjp(
+                    lambda p, h, am: call_stage(p, h, am), params, h_in, aux_m
+                )
+                dp, dh, da = vjp_fn(gbar.astype(g.dtype))
+                # where, not multiply-by-flag: a nan computed on a bubble
+                # tick's garbage must not poison the accumulator (0*nan)
+                dparams = jax.tree_util.tree_map(
+                    lambda acc, d: acc
+                    + jnp.where(active, d.astype(acc.dtype), 0.0),
+                    dparams, dp,
+                )
+                daux = jax.tree_util.tree_map(
+                    lambda acc, d: acc.at[m_c].add(
+                        jnp.where(active, d.astype(acc.dtype), 0.0)
+                    ),
+                    daux, da,
+                )
+                dxs = jnp.where(
+                    jnp.logical_and(active, idx == 0),
+                    dxs.at[m_c].set(dh.astype(dxs.dtype)),
+                    dxs,
+                )
+                wire = jnp.where(active, dh.astype(buf.dtype), buf * 0.0)
+                buf = jax.lax.ppermute(wire, axis_name, inv_perm)
+                return buf, dxs, dparams, daux
+
+            _, dxs, dparams, daux = jax.lax.fori_loop(
+                0, S + M - 1, tick, (buf0, dxs0, dp0, da0)
+            )
+            dxs = jnp.where(idx == 0, dxs, jnp.zeros_like(dxs))
+            dxs = jax.lax.psum(dxs, axis_name)
+            # aux is shared by every stage: total cotangent sums over pp
+            daux = jax.lax.psum(daux, axis_name)
+            daux = jax.tree_util.tree_map(
+                lambda t, orig: t.reshape((t.shape[0] * t.shape[1],) + t.shape[2:])
+                .astype(orig.dtype),
+                daux, a,
+            )
+            dparams = jax.tree_util.tree_map(
+                lambda d, p: d[None].astype(p.dtype), dparams, params
+            )
+            return dparams, dxs.reshape(g.shape), daux
+
+        from jax import shard_map
+
+        param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), params)
+        x_spec = P(batch_axes)
+        aux_specs = jax.tree_util.tree_map(lambda _: P(batch_axes), a)
+        dparams, dx, daux = shard_map(
+            local_bwd,
+            mesh=mesh,
+            in_specs=(
+                param_specs, P(axis_name, None, batch_axes), aux_specs, x_spec
+            ),
+            out_specs=(param_specs, x_spec, aux_specs),
+        )(params, saves, a, g)
+        return dparams, dx.astype(x_dtype), daux
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked_params, x, aux_dict)

@@ -82,6 +82,12 @@ class ILQLTrainer(BaseRLTrainer):
         self.pp_stages = dict(self.mesh.shape).get("pp", 1)
         self.pp_microbatches = train.pp_microbatches
         self.pp_virtual_stages = train.pp_virtual_stages
+        self.pp_remat = train.pp_remat
+        if self.pp_remat and self.pp_virtual_stages > 1:
+            raise NotImplementedError(
+                "pp_remat runs the v=1 schedule; drop pp_virtual_stages "
+                "or pp_remat"
+            )
         self.rng = set_seed(train.seed)
 
         if tokenizer is None and config.model.tokenizer_path:
@@ -251,6 +257,7 @@ class ILQLTrainer(BaseRLTrainer):
                         self.mesh, self.pp_microbatches,
                         two_qs=method.two_qs,
                         virtual_stages=self.pp_virtual_stages,
+                        remat=self.pp_remat,
                     )
                 elif moe_family:
                     out, sown = self.model.apply(

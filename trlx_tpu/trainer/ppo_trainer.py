@@ -174,6 +174,13 @@ class PPOTrainer(BaseRLTrainer):
         self.pp_stages = dict(self.mesh.shape).get("pp", 1)
         self.pp_microbatches = train.pp_microbatches
         self.pp_virtual_stages = train.pp_virtual_stages
+        self.pp_remat = train.pp_remat
+        if self.pp_remat and self.pp_virtual_stages > 1:
+            raise NotImplementedError(
+                "pp_remat runs the v=1 schedule; drop pp_virtual_stages "
+                "or pp_remat (the two memory/bubble trades do not compose "
+                "yet)"
+            )
         if self.pp_stages > 1:
             self._validate_pp_mesh(config, train)
 
@@ -543,6 +550,7 @@ class PPOTrainer(BaseRLTrainer):
                 self.model_config, params, full_ids, full_mask, Q,
                 self.mesh, self.pp_microbatches,
                 virtual_stages=self.pp_virtual_stages,
+                remat=self.pp_remat,
             )
         elif self._moe_family:
             from trlx_tpu.models.gpt2_moe import moe_loss_summary

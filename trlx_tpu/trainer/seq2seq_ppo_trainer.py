@@ -219,6 +219,7 @@ class Seq2SeqPPOTrainer(PPOTrainer):
                 self.model_config, params, mb.query_tokens, mb.query_mask,
                 dec_ids, dec_mask, self.mesh, self.pp_microbatches,
                 virtual_stages=self.pp_virtual_stages,
+                remat=self.pp_remat,
             )
             out = {"logits": logits, "values": values}
         else:
