@@ -298,7 +298,7 @@ def test_ilql_pp_decode_and_training():
 
     os.environ["WANDB_DISABLED"] = "1"
 
-    def ilql_config(mesh):
+    def ilql_config(mesh, **train_overrides):
         return TRLConfig.from_dict(
             {
                 "model": {
@@ -317,6 +317,7 @@ def test_ilql_pp_decode_and_training():
                     "seed": 7,
                     "orchestrator": "OfflineOrchestrator",
                     "trainer": "ILQLTrainer",
+                    **train_overrides,
                 },
                 "method": {
                     "name": "ILQLConfig",
@@ -365,6 +366,17 @@ def test_ilql_pp_decode_and_training():
     )
     assert int(trainer.state.step) == 4
     leaves = jax.tree_util.tree_leaves(trainer.state.params)
+    assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
+
+    # round-4: the same offline run with the rematerialized pipeline
+    # backward (train.pp_remat threads into pp_ilql_forward)
+    t_rm = trlx_tpu.train(
+        dataset=(samples, rewards),
+        config=ilql_config({"dp": 2, "fsdp": 2, "tp": 1, "pp": 2},
+                           pp_remat=True),
+    )
+    assert int(t_rm.state.step) == 4 and t_rm.pp_remat
+    leaves = jax.tree_util.tree_leaves(t_rm.state.params)
     assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
 
 
