@@ -1,0 +1,189 @@
+"""A/B: multi-token / speculative decode on the pretrained stand-in
+(VERDICT r4 #3 — the last structural collect-phase lever).
+
+Decode is op-LATENCY-bound on this link (~1.5 ms/step at the bench shape
+vs a ~0.5 ms traffic floor; ROADMAP "Round-4 perf findings" #3), which is
+exactly the regime where speculative decoding pays: k cheap draft steps +
+ONE full-model verify pass replace k sequential full steps, and the verify
+pass (k tokens at once) costs about the same latency as a single-token
+step.
+
+Stage 1 (this file, always runs) — the math that decides viability without
+building the sampler:
+
+- **Acceptance probe.** For speculative sampling the per-position
+  acceptance probability is EXACTLY ``sum_x min(p(x), q(x))`` (p = target,
+  q = draft). We sample real rollouts from the locally-pretrained stand-in
+  checkpoint (`ckpts/standin_gpt2`, real output distribution — the r4
+  "random-init can't exercise acceptance" excuse does not apply here),
+  then evaluate that sum at every response position for the natural
+  self-draft: a 1-layer early exit reusing the target's own
+  wte/wpe/h_0/ln_f/head (no separate draft training, no extra memory).
+- **Latency probe.** Measured per-step latency of the draft (1-layer) vs
+  target (2-layer) samplers at the reward-tier shape, chained inside one
+  jit (tunnel methodology).
+- **Projection.** Expected accepted tokens per round for k drafts is
+  ``(1 - a^(k+1)) / (1 - a)`` (a = acceptance); round cost is
+  ``k * t_draft + t_verify``. Speedup = tokens/round / (cost_round /
+  t_target). Printed for k = 1..6 with the argmax.
+
+Stage 2 (only if the projection clears 1.1x): implement the compiled
+speculative sampler and measure end-to-end. If the projection is below
+threshold, this file IS the measured-negative artifact — the methodology
+and numbers say why the lever stays unpulled.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples")
+)
+
+K_RANGE = range(1, 7)
+
+
+def main():
+    os.environ.setdefault("WANDB_DISABLED", "1")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pretrained_standin import (
+        causal_rl_config, ensure_gpt2_checkpoint, make_prompts,
+    )
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    ckpt = ensure_gpt2_checkpoint()
+    config = TRLConfig.from_dict(causal_rl_config(ckpt))
+    trainer = get_trainer(config.train.trainer)(
+        config, reward_fn=lambda **kw: [0.0]
+    )
+    gen = trainer.gen_config
+    B, Q = 64, 8
+    R = gen.max_new_tokens
+
+    rng = np.random.default_rng(0)
+    prompts = make_prompts(rng, B, Q)
+    prompt_ids = jnp.asarray(
+        [p + [0] * (Q - len(p)) for p in prompts], jnp.int32
+    )[:, :Q]
+    prompt_mask = jnp.ones((B, Q), jnp.int32)
+
+    out = trainer.sample(prompt_ids, prompt_mask)
+    full_ids = out.tokens  # [B, Q + R]
+    resp_mask = np.asarray(out.response_mask, bool)
+
+    backbone_params = trainer.state.params["transformer"]
+    arch = trainer.model_config
+
+    # target probs at response-predicting positions
+    def probs_of(model, params):
+        o = model.apply(
+            {"params": params}, full_ids,
+            attention_mask=jnp.ones_like(full_ids),
+        )
+        logits = o["logits"][:, Q - 1 : -1].astype(jnp.float32)
+        if gen.temperature and gen.temperature != 1.0:
+            logits = logits / gen.temperature
+        return jax.nn.softmax(logits, axis=-1)
+
+    from trlx_tpu.models.registry import get_model_family
+
+    family = get_model_family("gpt2")
+    target_probs = jax.jit(
+        lambda p: probs_of(trainer.backbone, p)
+    )(backbone_params)
+
+    # self-draft: 1-layer early exit reusing wte/wpe/h_0/ln_f (+tied head)
+    draft_arch = family.config_cls.from_dict(
+        {**{k: getattr(arch, k) for k in (
+            "vocab_size", "n_positions", "n_embd", "n_head",
+        )}, "n_layer": 1, "dtype": arch.dtype}
+    )
+    draft_model = family.backbone_cls(draft_arch)
+    draft_params = {
+        k: backbone_params[k] for k in ("wte", "wpe", "h_0", "ln_f")
+    }
+    draft_probs = jax.jit(
+        lambda p: probs_of(draft_model, p)
+    )(draft_params)
+
+    accept = jnp.sum(
+        jnp.minimum(target_probs, draft_probs), axis=-1
+    )  # [B, R]
+    a = float(
+        (np.asarray(accept) * resp_mask).sum() / max(resp_mask.sum(), 1)
+    )
+
+    # --- latency probe: chained decode steps inside one jit ------------
+    from trlx_tpu.models.gpt2 import init_cache
+
+    def step_latency(model, params, n_layers_tag):
+        C = Q + R
+        cache = init_cache(model.config, B, C)
+        ids0 = jnp.zeros((B, 1), jnp.int32)
+
+        def body(carry, _):
+            ids, cache = carry
+            o = model.apply(
+                {"params": params}, ids,
+                attention_mask=jnp.ones((B, C), jnp.int32),
+                cache=cache, cache_index=jnp.int32(Q),
+            )
+            nxt = jnp.argmax(o["logits"][:, -1], axis=-1)[:, None].astype(
+                jnp.int32
+            )
+            return (nxt, o["cache"]), None
+
+        def run(ids, cache):
+            (ids, cache), _ = jax.lax.scan(
+                body, (ids, cache), None, length=50
+            )
+            return ids
+
+        fn = jax.jit(run)
+        r = fn(ids0, cache)
+        jax.block_until_ready(r)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.time()
+            jax.block_until_ready(fn(ids0, cache))
+            best = min(best, time.time() - t0)
+        return best / 50
+
+    t_target = step_latency(trainer.backbone, backbone_params, 2)
+    t_draft = step_latency(draft_model, draft_params, 1)
+    # verify pass = one full-model forward over k+1 tokens with cache —
+    # latency-bound, so approximate with the measured single-step target
+    # latency (k tokens widen an already tiny matmul)
+    t_verify = t_target
+
+    proj = {}
+    for k in K_RANGE:
+        tokens = (1 - a ** (k + 1)) / (1 - a) if a < 1 else k + 1
+        cost = k * t_draft + t_verify
+        proj[k] = tokens / (cost / t_target)
+    best_k = max(proj, key=proj.get)
+
+    result = {
+        "acceptance_rate": round(a, 4),
+        "t_target_ms": round(t_target * 1e3, 3),
+        "t_draft_ms": round(t_draft * 1e3, 3),
+        "projected_speedup_by_k": {k: round(v, 3) for k, v in proj.items()},
+        "best_k": best_k,
+        "best_projected_speedup": round(proj[best_k], 3),
+        "verdict": (
+            "IMPLEMENT stage 2" if proj[best_k] > 1.1 else
+            "NEGATIVE: projection below 1.1x — lever stays unpulled"
+        ),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,30 @@
 """Benchmark: PPO samples/sec/chip on the BASELINE workload shape.
 
 Workload (BASELINE.md): gpt2-small policy (124M, bf16), query length 64,
-128-token... 48-token rollouts (reference test_config: gen len 48, batch 16,
+48-token rollouts (reference test_config: gen len 48, batch 16,
 128 rollouts/phase, 4 ppo_epochs). One full PPO phase = collect 128 rollouts
 (compiled sampler + reward + KL penalty vs frozen ref) + 32 optimizer steps
-(8 minibatches x 4 ppo_epochs). As the reference workload specifies
-(test_config.yml:5 num_layers_unfrozen: 2), only the top 2 blocks train and
-the KL reference is the hydra shared-trunk frozen branch; the backward is
-pruned below the branch point and MFU accounting charges only performed
-FLOPs (see _phase_flops). Weights are randomly initialized (zero-egress
-environment: no HF downloads) — identical compute to the pretrained model.
+(8 minibatches x 4 ppo_epochs). Weights are randomly initialized (zero-
+egress environment: no HF downloads) — identical compute to the pretrained
+model.
+
+BOTH workload definitions are measured every round (VERDICT r4 #1):
+
+- **Headline (`value`): the faithful reconstruction of the reference as
+  shipped.** In the actual reference code the PPO-path freezing block is
+  COMMENTED OUT (`accelerate_base_model.py:55-69`) — with test_config.yml's
+  `num_layers_unfrozen: 2` the policy still trains ALL 12 layers; the
+  setting only sizes the hydra frozen KL-ref branch (`ppo_models.py:
+  525-536`). Expressed here as `num_layers_unfrozen: 0` +
+  `ref_branch_layers: 2` (full training, 2-layer hydra ref). This is the
+  same definition rounds 1-3 measured (they paid a FULL-COPY ref — strictly
+  more ref compute than the reference's own hydra branch).
+- **Secondary (`value_frozen_top2`): the lightened workload round 4
+  mistakenly reported as faithful** (freezing re-enabled: only the top 2
+  blocks train, backward pruned below the branch point). Kept for series
+  continuity with BENCH_r04 and as the work-avoidance capability number.
+
+MFU accounting charges only performed FLOPs per definition (_phase_flops).
 
 The reference publishes no numbers (BASELINE.md), so the falsifiable
 claims here are the hardware-grounded ones: decode/train tokens/s,
@@ -86,6 +101,30 @@ def _collect_bytes(d, V, L, Q, R, B, kv_cache_bytes=1, weight_bytes=2):
     return decode + prefill + ref
 
 
+def _train_step_bytes(d, V, L, Q, R, B, unfrozen=0):
+    """Architecturally-required HBM bytes for ONE optimizer step — the
+    roofline denominator for ``train_phase_hbm_util`` (VERDICT r4 #2,
+    mirrors bench_train_audit.py). Lower bound: fused per-layer
+    activations uncounted.
+
+    - weights: fwd+bwd each read the bf16 compute cast; f32 grads written;
+    - optimizer: grads read, f32 m+v read+write, f32 masters read+write
+      (frozen leaves carry no moments — scale by the trainable fraction);
+    - logits pipeline: the [B, R, V] f32 buffer crosses HBM ~5 times
+      (head write, logsumexp read, bwd softmax rebuild+read, dlogits
+      write+read into the head transpose);
+    - residual stream saved for bwd (bf16 write+read per layer).
+    """
+    n_params = L * (12 * d * d + 13 * d) + V * d + 2 * d
+    frac = unfrozen / L if 0 < unfrozen < L else 1.0
+    trainable = n_params * frac
+    weights = 2 * 2 * n_params + 4 * trainable
+    optimizer = 4 * trainable + 16 * trainable + 8 * trainable
+    logits = 5 * B * R * V * 4
+    acts = 2 * 2 * B * (Q + R) * d * (L * frac)
+    return weights + optimizer + logits + acts
+
+
 def _phase_flops(d, V, L, Q, R, B, ppo_epochs, unfrozen=0):
     """Total matmul FLOPs for one PPO phase (collect + train), exact —
     counting only FLOPs the programs actually perform.
@@ -98,12 +137,18 @@ def _phase_flops(d, V, L, Q, R, B, ppo_epochs, unfrozen=0):
     positions in ref scoring / training (`response_forward` slices hidden
     to responses before the heads). Value head and layernorms negligible.
 
-    With ``unfrozen=k > 0`` (the reference test_config.yml workload trains
-    only the top k blocks): the KL reference is the hydra shared-trunk
-    branch — a full trunk pass plus a k-layer frozen-branch re-run — and
-    the backward is pruned below the branch point (stop_gradient +
-    dead-code elimination), so bwd = 2x the top-k trunk slice + one
-    d_hidden matmul through the (frozen, tied) lm head.
+    With ``unfrozen=k > 0`` (the frozen-top2 SECONDARY workload — the
+    reference as shipped trains all layers, its freezing block being
+    commented out): the backward is pruned below the branch point
+    (stop_gradient + dead-code elimination), so bwd = 2x the top-k trunk
+    slice + one d_hidden matmul through the (frozen, tied) lm head.
+
+    The ref term is one full-depth pass in BOTH definitions: a hydra ref
+    is (L-k) shared-trunk layers (XLA prunes the capture pass's top-k —
+    only branch_hidden is consumed; pinned by
+    ``test_freezing.py::test_hydra_capture_flops_match_truncated_trunk``)
+    plus k frozen-branch layers + head, and a full-copy ref is L layers +
+    head — identical FLOPs.
     """
     trunk = L * 12 * d * d
     T = Q + R
@@ -213,25 +258,22 @@ def _reward_tier(budget_seconds=300.0, eps=0.01, patience=4, min_phases=8):
         return {"mean_reward_error": f"{type(e).__name__}: {e}"}
 
 
-def main():
-    import numpy as np
+def _workload_config(num_layers_unfrozen, ref_branch_layers):
+    """The BASELINE workload at one of the two freezing definitions.
 
+    Faithful (headline): ``(0, 2)`` — the reference as shipped trains ALL
+    layers (freezing commented out, `accelerate_base_model.py:55-69`) with
+    the 2-layer hydra KL-ref branch that `test_config.yml:5` actually
+    sizes. Frozen-top2 (secondary): ``(2, None)`` — freezing re-enabled.
+    """
     from trlx_tpu.data.configs import TRLConfig
-    from trlx_tpu.utils.loading import get_orchestrator, get_pipeline, get_trainer
 
-    os.environ.setdefault("WANDB_DISABLED", "1")
-
-    config = TRLConfig.from_dict(
+    return TRLConfig.from_dict(
         {
             "model": {
                 "model_type": "gpt2",
-                # the reference workload trains only the top 2 blocks and
-                # uses the hydra shared-trunk frozen branch as the KL
-                # reference (`configs/test_config.yml:5`
-                # num_layers_unfrozen: 2) — rounds 1-3 trained all 12
-                # layers with a full frozen copy, i.e. strictly MORE work
-                # than the reference's workload definition
-                "num_layers_unfrozen": 2,
+                "num_layers_unfrozen": num_layers_unfrozen,
+                "ref_branch_layers": ref_branch_layers,
                 "model_arch": {
                     "vocab_size": 50257,
                     "n_positions": 1024,
@@ -282,6 +324,14 @@ def main():
         }
     )
 
+def measure_throughput(config, n_phases=5):
+    """Run the PPO phase loop for one workload definition and return the
+    hardware-grounded metrics (samples/s/chip, tok/s, MFU, HBM util)."""
+    import jax
+    import numpy as np
+
+    from trlx_tpu.utils.loading import get_orchestrator, get_pipeline, get_trainer
+
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(100, 40000, size=rng.integers(4, 33)))
                for _ in range(512)]
@@ -297,8 +347,6 @@ def main():
     orch = get_orchestrator(config.train.orchestrator)(
         trainer, pipeline, reward_fn=reward_fn, chunk_size=config.method.chunk_size
     )
-
-    import jax
 
     times = {"collect": 0.0, "train": 0.0}
 
@@ -321,7 +369,6 @@ def main():
     one_phase()  # warmup: compile sampler + fused train phase
     one_phase()  # second warmup: absorbs any donated-buffer relayout retrace
 
-    n_phases = 5
     start = time.time()
     for _ in range(n_phases):
         one_phase(record=True)
@@ -345,7 +392,8 @@ def main():
     achieved_tflops = (
         n_phases * (collect_flops + train_flops) / elapsed / n_chips / 1e12
     )
-    extras = {
+    out = {
+        "value": round(per_chip, 3),
         # generated tokens over the whole collect window (incl. prefill,
         # frozen-ref forward, host reward) — rollout throughput, not a
         # bare decode-step rate
@@ -359,16 +407,18 @@ def main():
         ),
         "achieved_tflops_per_chip": round(achieved_tflops, 2),
         "device_kind": kind,
+        "collect_ms_per_phase": round(times["collect"] / n_phases * 1e3, 1),
+        "train_ms_per_phase": round(times["train"] / n_phases * 1e3, 1),
     }
     if peak:
-        extras["mfu"] = round(achieved_tflops / peak, 4)
-        extras["bf16_peak_tflops"] = peak
-        extras["train_phase_mfu"] = round(
+        out["mfu"] = round(achieved_tflops / peak, 4)
+        out["bf16_peak_tflops"] = peak
+        out["train_phase_mfu"] = round(
             n_phases * train_flops / times["train"] / n_chips / 1e12 / peak, 4
         )
         # the weakest phase gets its own falsifiable number (VERDICT r2):
         # collect = compiled sampler + frozen-ref forward + host reward
-        extras["collect_phase_mfu"] = round(
+        out["collect_phase_mfu"] = round(
             n_phases * collect_flops / times["collect"] / n_chips / 1e12 / peak,
             4,
         )
@@ -387,21 +437,62 @@ def main():
             kv_cache_bytes=1 if kv_dtype == "int8" else 2,
         )
         gbps = n_phases * per_chip_bytes / times["collect"] / 1e9
-        extras["collect_phase_hbm_gbps"] = round(gbps, 1)
-        extras["collect_phase_hbm_util"] = round(gbps / hbm_peak, 4)
+        out["collect_phase_hbm_gbps"] = round(gbps, 1)
+        out["collect_phase_hbm_util"] = round(gbps / hbm_peak, 4)
+        # train-phase roofline next to its MFU (VERDICT r4 #2): required
+        # bytes per step x steps over measured train time
+        steps = config.method.ppo_epochs * (B // config.train.batch_size)
+        step_bytes = _train_step_bytes(
+            d=arch["n_embd"], V=arch["vocab_size"], L=arch["n_layer"],
+            Q=Q, R=R, B=config.train.batch_size // n_chips,
+            unfrozen=config.model.num_layers_unfrozen,
+        )
+        tgbps = n_phases * steps * step_bytes / times["train"] / 1e9
+        out["train_phase_hbm_gbps"] = round(tgbps, 1)
+        out["train_phase_hbm_util"] = round(tgbps / hbm_peak, 4)
+    return out
+
+
+def main():
+    os.environ.setdefault("WANDB_DISABLED", "1")
+
+    # HEADLINE: faithful reconstruction of the reference as shipped — all
+    # 12 layers train (the reference's PPO freezing is commented out),
+    # 2-layer hydra KL-ref branch (what test_config.yml:5 actually sizes).
+    # Same definition as the r1-r3 series (those paid a full-copy ref).
+    faithful = measure_throughput(_workload_config(0, 2))
+    # SECONDARY: the frozen-top2 workload r4 headline'd (freezing
+    # re-enabled as work-avoidance; lighter train phase).
+    frozen = measure_throughput(_workload_config(2, None))
+
+    extras = dict(faithful)
+    per_chip = extras.pop("value")
+    extras["value_frozen_top2"] = frozen["value"]
+    extras["vs_baseline_frozen_top2"] = round(
+        frozen["value"] / A100_BASELINE_SAMPLES_PER_SEC, 3
+    )
+    for k in ("train_tok_per_sec_per_chip", "train_phase_mfu",
+              "train_ms_per_phase", "collect_ms_per_phase"):
+        if k in frozen:
+            extras[f"{k}_frozen_top2"] = frozen[k]
 
     extras.update(_reward_tier())
+    ratio = per_chip / A100_BASELINE_SAMPLES_PER_SEC
+    # machine-readable north-star (VERDICT r4 #7)
+    extras["north_star_throughput_ratio"] = round(ratio, 3)
+    extras["north_star_throughput_met"] = ratio >= 4.0
+    extras["north_star_reward_status"] = "env-blocked-standin"
     if "reward_plateau" in extras:
-        ratio = per_chip / A100_BASELINE_SAMPLES_PER_SEC
+        extras["standin_reward_plateau"] = extras["reward_plateau"]
         verb = (
             "plateaus at" if extras.get("reward_plateaued")
             else "reaches (budget-capped, still rising)"
         )
         extras["north_star"] = (
-            f"throughput {per_chip:.0f} samples/s/chip = {ratio:.1f}x the "
-            f"documented single-A100 torch-trlX estimate (>=4x required); "
-            f"reward >=1.2 on gpt2-imdb+distilbert is env-blocked (zero "
-            f"egress) — stand-in sentiment task {verb} "
+            f"throughput {per_chip:.0f} samples/s/chip (faithful full-train "
+            f"workload) = {ratio:.1f}x the documented single-A100 torch-trlX "
+            f"estimate (>=4x required); reward >=1.2 on gpt2-imdb+distilbert "
+            f"is env-blocked (zero egress) — stand-in sentiment task {verb} "
             f"{extras['reward_plateau']} (range [-1,1]) after "
             f"{extras['reward_plateau_steps']} updates"
         )
@@ -410,7 +501,7 @@ def main():
         json.dumps(
             {
                 "metric": "ppo_samples_per_sec_per_chip_gpt2s",
-                "value": round(per_chip, 3),
+                "value": per_chip,
                 "unit": "samples/s/chip",
                 "vs_baseline": round(per_chip / A100_BASELINE_SAMPLES_PER_SEC, 3),
                 **extras,

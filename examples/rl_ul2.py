@@ -87,6 +87,71 @@ def load_pairs(tsv_path: str) -> Tuple[List[str], List[str]]:
     return prompts, gts
 
 
+class CharTokenizer:
+    """Decode token ids to distinct Chinese characters (the fork's
+    domain): char-n-gram F then measures *token* overlap exactly, giving
+    the reward a real gradient — digit-string decoding makes every
+    candidate look alike to character n-grams."""
+
+    eos_token_id = 1
+    pad_token_id = 0
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(
+            chr(0x4E00 + int(i)) for i in ids
+            if not (skip_special_tokens and int(i) in (0, 1))
+        )
+
+
+def standin_tier(
+    repo: str,
+    gt_tile_to: Optional[int] = None,
+    method_overrides: Optional[dict] = None,
+    **train_overrides,
+):
+    """Zero-egress stand-in tier: the fork's workload *shape* — a
+    genuinely pretrained seq2seq policy generating responses scored
+    against ground-truth pairs — built locally. The topic-pretrained
+    tiny T5 (examples/pretrained_standin.py) plays the UL2 checkpoint.
+    Returns ``(config, prompts, gts, tokenizer)``; shared by ``main`` and
+    the dp×pp e2e test (`tests/_rl_ul2_driver.py`).
+
+    ``gt_tile_to=n`` tiles each echo ground truth to n characters —
+    matching the stand-in's pretraining echo objective, whose labels are
+    the encoder tokens tiled to the decoder length
+    (`pretrained_standin.py::pretrain_t5_checkpoint`), so RL has a
+    reachable exact target. ``method_overrides`` merge into the method
+    config dict (e.g. the GRPO fields the e2e test uses)."""
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from pretrained_standin import (
+        ensure_t5_checkpoint,
+        sample_docs,
+        seq2seq_rl_config,
+    )
+
+    cfg = seq2seq_rl_config(ensure_t5_checkpoint(repo), **train_overrides)
+    if method_overrides:
+        cfg["method"].update(method_overrides)
+    config = TRLConfig.from_dict(cfg)
+    rng = np.random.default_rng(0)
+    docs = sample_docs(rng, 256, 8)
+    prompts = [list(map(int, d)) for d in docs]
+    tokenizer = CharTokenizer()
+    # ground truth = the prompt echoed (optionally tiled): a *reachable*
+    # target (every gt token is in the prompt's topic, which the
+    # pretrained policy already samples — and the pretrain objective
+    # includes echoing)
+    if gt_tile_to:
+        gts = [
+            tokenizer.decode(list(d) * 2)[:gt_tile_to] for d in docs
+        ]
+    else:
+        gts = [tokenizer.decode(d) for d in docs]
+    return config, prompts, gts, tokenizer
+
+
 def main(samples_tsv: Optional[str] = None, model_path: Optional[str] = None):
     import numpy as np
 
@@ -113,49 +178,10 @@ def main(samples_tsv: Optional[str] = None, model_path: Optional[str] = None):
                for _ in range(256)]
         tokenizer = None
     else:
-        # zero-egress stand-in tier: the fork's workload *shape* — a
-        # genuinely pretrained seq2seq policy generating responses scored
-        # against ground-truth pairs — built locally. The topic-pretrained
-        # tiny T5 (examples/pretrained_standin.py) plays the UL2
-        # checkpoint. This tier proves the full path (convert -> encoder-
-        # cached rollouts -> pair-scored char-F reward -> PPO updates);
-        # the char-F echo objective's flat exploration landscape means the
-        # short default run holds ~steady rather than climbing — seq2seq
-        # reward *growth* from a pretrained checkpoint is demonstrated in
-        # tests/test_learning.py and tests/test_pretrained_path.py[t5].
-        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-        from pretrained_standin import (
-            ensure_t5_checkpoint,
-            sample_docs,
-            seq2seq_rl_config,
-        )
-
-        config = TRLConfig.from_dict(
-            seq2seq_rl_config(ensure_t5_checkpoint(repo))
-        )
-        rng = np.random.default_rng(0)
-        docs = sample_docs(rng, 256, 8)
-        prompts = [list(map(int, d)) for d in docs]
-
-        # Decode token ids to distinct Chinese characters (the fork's
-        # domain): char-n-gram F then measures *token* overlap exactly,
-        # giving the reward a real gradient — digit-string decoding makes
-        # every candidate look alike to character n-grams.
-        class CharTokenizer:
-            eos_token_id = 1
-            pad_token_id = 0
-
-            def decode(self, ids, skip_special_tokens=True):
-                return "".join(
-                    chr(0x4E00 + int(i)) for i in ids
-                    if not (skip_special_tokens and int(i) in (0, 1))
-                )
-
-        tokenizer = CharTokenizer()
-        # ground truth = the prompt echoed: a *reachable* target (every gt
-        # token is in the prompt's topic, which the pretrained policy
-        # already samples)
-        gts = [tokenizer.decode(d) for d in docs]
+        # This tier proves the full path (convert -> encoder-cached
+        # rollouts -> pair-scored char-F reward -> PPO updates); reward
+        # growth under dp×pp is pinned in tests/test_rl_ul2_e2e.py.
+        config, prompts, gts, tokenizer = standin_tier(repo)
 
     trlx_tpu.train(
         reward_fn=make_reward_fn(),

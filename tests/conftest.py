@@ -10,9 +10,15 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# 8 virtual device threads share ONE physical core here: XLA's CPU
+# collective rendezvous hard-aborts the whole process (rendezvous.cc
+# Check failure -> SIGABRT) if any participant thread is starved past the
+# default 40 s — which under host load is a matter of luck. Raise the
+# termination timeout so slow is slow, not fatal.
+if "collective_call_terminate_timeout" not in flags:
+    flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+os.environ["XLA_FLAGS"] = flags
 
 import sys
 

@@ -792,6 +792,53 @@ def test_pp_remat_matches_and_trains():
         assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
 
 
+@pytest.mark.parametrize("model_type", ["gptj", "gpt_neo", "gpt_neox"])
+def test_pp_remat_matches_autodiff_nonfloat_leaves(model_type):
+    """Round-5 (ADVICE r4): the remat backward must handle non-inexact
+    leaves — gptj/neox thread int32 rotary position_ids through the aux
+    tree, gpt_neo carries bool band flags in the stage tree. ``jax.vjp``
+    hands back float0 cotangents for those; the backward closes over them
+    instead of differentiating and returns float0 zeros at the custom_vjp
+    boundary. Exact loss/grad parity vs the autodiffed schedule."""
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    trainer = get_trainer("PPOTrainer")(
+        _config({"dp": -1, "fsdp": 1, "tp": 1, "pp": 2},
+                model_type=model_type, pp_remat=True),
+        reward_fn=lambda **kw: [0.0],
+    )
+    assert trainer.pp_remat
+
+    rng = np.random.default_rng(1)
+    B, Q, R = 16, 4, 6
+    full_ids = jnp.asarray(rng.integers(1, 13, (B, Q + R)), jnp.int32)
+    full_mask = jnp.ones((B, Q + R), jnp.int32)
+    params = jax.device_get(trainer.state.params)
+
+    from trlx_tpu.models.pp_runner import pp_response_forward
+
+    def loss(p, remat):
+        logits, values = pp_response_forward(
+            trainer.model_config, p, full_ids, full_mask, Q,
+            trainer.mesh, trainer.pp_microbatches, remat=remat,
+        )
+        return jnp.mean(logits**2) + jnp.mean(values**2)
+
+    v_r, g_r = jax.jit(jax.value_and_grad(lambda p: loss(p, True)))(params)
+    v_a, g_a = jax.jit(jax.value_and_grad(lambda p: loss(p, False)))(params)
+    np.testing.assert_allclose(float(v_r), float(v_a), rtol=1e-6)
+    flat_r, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_r))
+    flat_a, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_a))
+    np.testing.assert_allclose(
+        np.asarray(flat_r), np.asarray(flat_a), atol=1e-5, rtol=1e-4
+    )
+
+
 def test_pp_rejects_misaligned_hydra_and_moe():
     from trlx_tpu.utils.loading import get_trainer
 

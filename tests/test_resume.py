@@ -110,6 +110,21 @@ def test_resume_with_masked_and_bf16_moment_opt_state(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_restore_into_changed_opt_layout_raises_actionable_error(tmp_path):
+    """ADVICE r4 (low): a checkpoint written under one optimizer-state
+    layout (here: full-size moments, no freezing) must not die deep inside
+    Orbax when restored under another (frozen-mask layout stores moments
+    only for the trainable slice) — load_checkpoint raises a ValueError
+    naming `num_layers_unfrozen` / the restart remedy instead."""
+    kw = dict(n_layer=4)
+    t1 = _train(_config(tmp_path, total_steps=2, **kw))
+    assert int(t1.state.step) == 2
+
+    with pytest.raises(ValueError, match="num_layers_unfrozen"):
+        _train(_config(tmp_path, total_steps=4, resume=True,
+                       num_layers_unfrozen=2, **kw))
+
+
 def test_ilql_api_default_eval_prompts_from_token_samples(tmp_path):
     """The offline API path derives eval prompts from (tokens, action_start)
     samples' prompt portions instead of feeding raw tuples to the prompt

@@ -1,0 +1,68 @@
+"""The fork's flagship path end-to-end under dp×pp (VERDICT r4 #8).
+
+`examples/rl_ul2.py` re-creates `ul2_RL/rl_ul2.py`'s dialogue PPO: a
+pretrained seq2seq policy generates responses that a pair-scored reward
+(char n-gram F vs ground truth, the jieba-BLEU/ROUGE stand-in) steers.
+This test runs that flow — the locally-pretrained T5 stand-in checkpoint,
+the example's `CharTokenizer` and `make_reward_fn`, echo ground truths —
+through the public `api.train` on a dp×pp mesh and requires the mean
+reward to RISE. The trainer is `Seq2SeqGRPOTrainer` (the fork's T5 path ×
+GRPO × pp in one run): the pair reward is a narrow target and grouped
+relative advantages learn it ~3× faster than vanilla PPO at the same
+budget (hyperparameter probes documented in `tests/_rl_ul2_driver.py`).
+
+The run lives in a SUBPROCESS (`tests/_rl_ul2_driver.py`) with one retry:
+XLA's CPU collective rendezvous hard-aborts the whole process (SIGABRT via
+rendezvous.cc's termination timeout) when a virtual-device thread starves
+on this oversubscribed shared host — an environment flake that must not be
+able to take down the pytest process with it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "tests", "_rl_ul2_driver.py")
+
+
+def test_rl_ul2_standin_tier_learns_under_dp_pp():
+    last = None
+    for _attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, DRIVER],
+            capture_output=True,
+            text=True,
+            timeout=1200,
+            cwd=REPO,
+        )
+        last = proc
+        if proc.returncode == 0:
+            break
+        # SIGABRT from the CPU-collective rendezvous check is the only
+        # retryable outcome; real failures surface their python traceback
+        assert proc.returncode == -6 or "rendezvous" in (
+            proc.stderr or ""
+        ), (proc.returncode, proc.stderr[-2000:])
+    assert last.returncode == 0, (
+        f"driver aborted twice (rendezvous flake or real crash): "
+        f"{last.stderr[-2000:]}"
+    )
+    line = next(
+        ln for ln in last.stdout.splitlines() if ln.startswith("RESULT:")
+    )
+    result = json.loads(line[len("RESULT:"):])
+    assert result["pp_stages"] == 2
+    assert result["step"] == result["total_steps"] == 384
+    means = result["means"]
+    early = float(np.mean(means[:4]))
+    late = float(np.mean(means[-8:]))
+    peak = float(np.max(means))
+    # probed trajectory (same seeds): early ~0.174, late-8 mean ~0.227,
+    # peak 0.263. Thresholds sit ~4 sigma below those — a flat curve
+    # (no learning) cannot clear the +0.03 sustained rise.
+    assert late > early + 0.03, (early, late, means)
+    assert peak > early + 0.06, (early, peak, means)

@@ -49,8 +49,18 @@ class ModelConfig:
     :param model_type: architecture family registered in
         ``trlx_tpu.models``: ``"gpt2"`` (causal LM) or ``"t5"`` (seq2seq).
     :param num_layers_unfrozen: train only the top-k transformer blocks
-        (reference `configs.py:42`); -1 trains everything. Also enables the
-        hydra shared-trunk frozen reference branch for PPO.
+        (reference `configs.py:42`); -1 trains everything. Also (by
+        default) sizes the hydra shared-trunk frozen reference branch for
+        PPO.
+    :param ref_branch_layers: depth of the hydra frozen KL-reference
+        branch, decoupled from freezing. In the reference as shipped the
+        PPO freezing block is commented out (`accelerate_base_model.py:
+        55-69`) — `num_layers_unfrozen` ONLY sizes the hydra branch
+        (`ppo_models.py:525-536`) while the policy trains all layers; this
+        key expresses that workload (e.g. ``num_layers_unfrozen: 0`` +
+        ``ref_branch_layers: 2``). ``None`` (default) follows
+        ``num_layers_unfrozen`` when positive; ``0`` forces the full-copy
+        reference.
     :param model_arch: from-scratch architecture overrides (n_layer, n_embd,
         n_head, vocab_size, n_positions, ...) when no checkpoint is given.
     """
@@ -59,7 +69,15 @@ class ModelConfig:
     tokenizer_path: str = ""
     model_type: str = "gpt2"
     num_layers_unfrozen: int = -1
+    ref_branch_layers: Optional[int] = None
     model_arch: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def resolved_ref_branch_layers(self) -> int:
+        """Hydra branch depth actually in effect (0 = full-copy ref)."""
+        if self.ref_branch_layers is not None:
+            return self.ref_branch_layers
+        return max(self.num_layers_unfrozen, 0)
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -403,6 +403,45 @@ def pipeline_apply_cached(
     )(stacked_params, x, cache, static_cache, cache_index, aux)
 
 
+def _partition_inexact(tree):
+    """Split a pytree into (inexact, other) halves with ``None`` sentinels.
+
+    The remat backward differentiates through the stage recompute; int/bool
+    leaves (rotary position_ids in aux, gpt_neo's local-band flags in the
+    stage tree) have no cotangent — ``jax.vjp`` hands back float0 arrays
+    that neither accumulate nor pass a dtype cast. They are carried to the
+    recompute via closure instead and get float0 zeros at the custom_vjp
+    boundary."""
+    inexact = lambda x: jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+    fpart = jax.tree_util.tree_map(lambda x: x if inexact(x) else None, tree)
+    opart = jax.tree_util.tree_map(lambda x: None if inexact(x) else x, tree)
+    return fpart, opart
+
+
+def _combine_inexact(fpart, opart):
+    """Inverse of :func:`_partition_inexact` (None sentinels as leaves)."""
+    return jax.tree_util.tree_map(
+        lambda f, o: o if f is None else f,
+        fpart,
+        opart,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def _insert_float0(cotangents_f, primals):
+    """Fill a partitioned cotangent tree back to the primal structure,
+    with float0 zeros (the required custom_vjp cotangent for non-inexact
+    primal inputs) at the ``None`` positions."""
+    return jax.tree_util.tree_map(
+        lambda c, p: np.zeros(np.shape(p), jax.dtypes.float0)
+        if c is None
+        else c,
+        cotangents_f,
+        primals,
+        is_leaf=lambda x: x is None,
+    )
+
+
 def pipeline_apply_remat(
     stage_fn: Callable,
     stacked_params,
@@ -474,6 +513,11 @@ def pipeline_apply_remat(
             aux_mbs = jax.tree_util.tree_map(
                 lambda t: t.reshape((M, t.shape[0] // M) + t.shape[1:]), a
             )
+            # differentiate only the inexact leaves — int/bool leaves
+            # (rotary position_ids, gpt_neo band flags) ride to the
+            # recompute via closure and take no cotangent
+            params_f, params_o = _partition_inexact(params)
+            aux_f, aux_o = _partition_inexact(aux_mbs)
             inv_perm = [(i, (i - 1) % n) for i in range(n)]
             pp_zero = (0.0 * idx).astype(g.dtype)
             buf0 = jnp.zeros_like(g_mbs[0]) + pp_zero
@@ -486,14 +530,14 @@ def pipeline_apply_remat(
                 lambda p: (0.0 * p).astype(
                     jnp.promote_types(p.dtype, jnp.float32)
                 ),
-                params,
+                params_f,
             )
             da0 = jax.tree_util.tree_map(
                 lambda t: (0.0 * t).astype(
                     jnp.promote_types(t.dtype, jnp.float32)
                 )
                 + (0.0 * idx),
-                aux_mbs,
+                aux_f,
             )
 
             def tick(r, carry):
@@ -504,10 +548,18 @@ def pipeline_apply_remat(
                 active = jnp.logical_and(m >= 0, m < M)
                 m_c = jnp.clip(m, 0, M - 1)
                 gbar = jnp.where(idx == n - 1, g_mbs[m_c], buf)
-                aux_m = jax.tree_util.tree_map(lambda t: t[m_c], aux_mbs)
+                aux_m_f = jax.tree_util.tree_map(lambda t: t[m_c], aux_f)
+                aux_m_o = jax.tree_util.tree_map(lambda t: t[m_c], aux_o)
                 h_in = saves[m_c]
                 _, vjp_fn = jax.vjp(
-                    lambda p, h, am: call_stage(p, h, am), params, h_in, aux_m
+                    lambda pf, h, af: call_stage(
+                        _combine_inexact(pf, params_o),
+                        h,
+                        _combine_inexact(af, aux_m_o),
+                    ),
+                    params_f,
+                    h_in,
+                    aux_m_f,
                 )
                 dp, dh, da = vjp_fn(gbar.astype(g.dtype))
                 # where, not multiply-by-flag: a nan computed on a bubble
@@ -539,13 +591,14 @@ def pipeline_apply_remat(
             dxs = jax.lax.psum(dxs, axis_name)
             # aux is shared by every stage: total cotangent sums over pp
             daux = jax.lax.psum(daux, axis_name)
+            a_f_full, _ = _partition_inexact(a)
             daux = jax.tree_util.tree_map(
                 lambda t, orig: t.reshape((t.shape[0] * t.shape[1],) + t.shape[2:])
                 .astype(orig.dtype),
-                daux, a,
+                daux, a_f_full,
             )
             dparams = jax.tree_util.tree_map(
-                lambda d, p: d[None].astype(p.dtype), dparams, params
+                lambda d, p: d[None].astype(p.dtype), dparams, params_f
             )
             return dparams, dxs.reshape(g.shape), daux
 
@@ -554,15 +607,27 @@ def pipeline_apply_remat(
         param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), params)
         x_spec = P(batch_axes)
         aux_specs = jax.tree_util.tree_map(lambda _: P(batch_axes), a)
+        # cotangent outputs exist only for the inexact leaves; the int/bool
+        # leaves get float0 zeros outside the shard_map
+        params_f_outer, _ = _partition_inexact(params)
+        aux_f_outer, _ = _partition_inexact(a)
         dparams, dx, daux = shard_map(
             local_bwd,
             mesh=mesh,
             in_specs=(
                 param_specs, P(axis_name, None, batch_axes), aux_specs, x_spec
             ),
-            out_specs=(param_specs, x_spec, aux_specs),
+            out_specs=(
+                jax.tree_util.tree_map(lambda _: P(axis_name), params_f_outer),
+                x_spec,
+                jax.tree_util.tree_map(lambda _: P(batch_axes), aux_f_outer),
+            ),
         )(params, saves, a, g)
-        return dparams, dx.astype(x_dtype), daux
+        return (
+            _insert_float0(dparams, params),
+            dx.astype(x_dtype),
+            _insert_float0(daux, a),
+        )
 
     run.defvjp(run_fwd, run_bwd)
     return run(stacked_params, x, aux_dict)

@@ -30,14 +30,43 @@ class TrainState:
     step: jax.Array  # int32 scalar
 
 
-def unfrozen_param_mask(params: Any, num_layers_unfrozen: int, n_layer: int) -> Any:
+def unfrozen_param_mask(
+    params: Any,
+    num_layers_unfrozen: int,
+    n_layer: int,
+    zero_freezes_all: bool = False,
+) -> Any:
     """True for trainable leaves. With ``num_layers_unfrozen=k > 0``, only the
-    top-k transformer blocks + final layernorm + heads train (reference
-    freezes everything below the branch point). ``k <= 0`` trains everything
-    — the reference's ``freeze_bottom_causal_layers`` freezes nothing at 0
-    (its hidden-layer slice is empty unless k > 0), and the fork's own
-    ``ppo_config.yml:5`` uses 0 for full training."""
-    if num_layers_unfrozen <= 0:
+    top-k transformer blocks + final layernorm + heads train.
+
+    What the reference actually does with ``num_layers_unfrozen`` differs by
+    path, and the two are mapped here via ``zero_freezes_all``:
+
+    - **PPO path** (``zero_freezes_all=False``): the freezing block in
+      ``accelerate_base_model.py:55-69`` is **commented out** in the
+      reference as shipped — the policy trains ALL layers regardless of the
+      setting (it only sizes the hydra KL-ref branch, ``ppo_models.py:
+      525-536``). So ``k <= 0`` trains everything here, and ``k > 0`` is
+      the re-enabled behavior of that commented code (freeze the bottom
+      ``n_layer - k`` blocks), offered as real work-avoidance.
+    - **ILQL path** (``zero_freezes_all=True``): ``ilql_models.py:217-225``
+      is live code — ``0`` freezes ALL blocks, ``k > 0`` freezes the bottom
+      ``n_layer - k``, negative freezes none. ``k == 0`` therefore maps to
+      ``first_trainable == n_layer`` (every block frozen; heads + ln_f
+      still train).
+
+    Documented divergence (PARITY.md quirks): the reference freezes only
+    the *blocks* — wte/wpe stay trainable; this mask also freezes the
+    embeddings below the branch point, consistent with the hydra branch
+    point being the first trainable position."""
+    if num_layers_unfrozen > n_layer:
+        raise ValueError(
+            f"model.num_layers_unfrozen={num_layers_unfrozen} exceeds "
+            f"n_layer={n_layer}"
+        )
+    if num_layers_unfrozen < 0 or (
+        num_layers_unfrozen == 0 and not zero_freezes_all
+    ):
         return jax.tree_util.tree_map(lambda _: True, params)
     first_trainable = n_layer - num_layers_unfrozen
 

@@ -154,8 +154,15 @@ class ILQLTrainer(BaseRLTrainer):
         self.target_shardings = self._shardings_for(target_q)
         target_q = jax.device_put(target_q, self.target_shardings)
 
+        # zero_freezes_all: the reference's ILQL freezing is live code and
+        # freezes ALL gpt blocks at num_layers_unfrozen == 0
+        # (ilql_models.py:217-225) — unlike the PPO path, whose freezing
+        # block is commented out (accelerate_base_model.py:55-69)
         trainable = unfrozen_param_mask(
-            params, config.model.num_layers_unfrozen, num_layers_of(self.model_config)
+            params,
+            config.model.num_layers_unfrozen,
+            num_layers_of(self.model_config),
+            zero_freezes_all=True,
         )
         self.trainable_mask = trainable
         self.tx = make_optimizer(train, train.total_steps, trainable)

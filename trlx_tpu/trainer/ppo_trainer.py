@@ -212,16 +212,32 @@ class PPOTrainer(BaseRLTrainer):
 
         # Frozen KL reference. Two modes, as upstream (`ppo_models.py:505-558`
         # vs `ppo_orchestrator.py:41-43`):
-        # - hydra (num_layers_unfrozen > 0): keep only the top-k blocks +
-        #   ln_f + embedding as the frozen branch; the (frozen) trunk is
-        #   shared with the policy — half the reference-model memory;
+        # - hydra (branch depth > 0): keep only the top-k blocks + ln_f +
+        #   embedding as the frozen branch; the (frozen) trunk is shared
+        #   with the policy — half the reference-model memory;
         # - full copy otherwise (the fork's active path for T5).
+        # The branch depth is `model.ref_branch_layers` when set, else
+        # `num_layers_unfrozen` — decoupled because in the reference as
+        # shipped num_layers_unfrozen ONLY sizes the branch
+        # (`ppo_models.py:525-536`; the freezing block is commented out)
+        # while the policy trains all layers.
         # jnp.copy forces fresh buffers — the policy's are donated each step.
-        self.use_hydra = (
-            config.model.num_layers_unfrozen > 0 and self._supports_hydra()
-        )
+        self.ref_branch = config.model.resolved_ref_branch_layers
+        if not 0 <= self.ref_branch <= self._n_layers():
+            key = (
+                "model.ref_branch_layers"
+                if config.model.ref_branch_layers is not None
+                # unset: the value defaulted from num_layers_unfrozen —
+                # name the key the user actually wrote
+                else "model.num_layers_unfrozen"
+            )
+            raise ValueError(
+                f"{key}={self.ref_branch} must be in "
+                f"[0, n_layer={self._n_layers()}]"
+            )
+        self.use_hydra = self.ref_branch > 0 and self._supports_hydra()
         if self.use_hydra:
-            self.branch_start = self._n_layers() - config.model.num_layers_unfrozen
+            self.branch_start = self._n_layers() - self.ref_branch
             backbone = params[self.backbone_key]
             # keep top-k blocks + everything the LM head path needs (ln_f,
             # tied wte or untied lm_head); drop trunk blocks + wpe
@@ -367,19 +383,19 @@ class PPOTrainer(BaseRLTrainer):
             raise ValueError(
                 f"n_layer={L} must divide into pp={self.pp_stages} stages"
             )
-        if config.model.num_layers_unfrozen > 0:
+        if config.model.resolved_ref_branch_layers > 0:
             # hydra under pp needs the branch point on a stage boundary
             # (the capture is a stage's input — round 3; previously
             # refused outright)
             chunk = L // self.pp_stages
-            branch = L - config.model.num_layers_unfrozen
+            branch = L - config.model.resolved_ref_branch_layers
             if branch % chunk:
                 raise NotImplementedError(
                     f"hydra under pp needs the branch point on a stage "
                     f"boundary: L={L}, pp={self.pp_stages} gives stage "
-                    f"size {chunk}, but L - num_layers_unfrozen = "
-                    f"{branch}; adjust num_layers_unfrozen or use the "
-                    f"full-copy reference"
+                    f"size {chunk}, but L - ref_branch_layers = "
+                    f"{branch}; adjust num_layers_unfrozen / "
+                    f"ref_branch_layers or use the full-copy reference"
                 )
             if train.pp_virtual_stages > 1:
                 raise NotImplementedError(
@@ -575,12 +591,18 @@ class PPOTrainer(BaseRLTrainer):
         return True
 
     def _ref_logprobs(self, ref_params, policy_params, q_ids, q_mask, r_ids, r_mask):
-        """Frozen-reference logprobs of the sampled responses.
+        """KL-reference logprobs of the sampled responses.
 
-        Hydra mode re-runs only the frozen top blocks from the shared
+        Hydra mode re-runs only the frozen-copy top blocks from the shared
         trunk's activation (`ppo_models.py:541-558`); ``policy_params``
-        provide the trunk (identical to the branch's original trunk — those
-        layers are frozen)."""
+        provide the trunk. Whether that trunk is stationary depends on the
+        freezing config: with ``num_layers_unfrozen > 0`` the trunk layers
+        are frozen and the reference is fixed; with the decoupled faithful
+        config (``num_layers_unfrozen: 0`` + ``ref_branch_layers``) the
+        trunk TRAINS, so the hydra reference drifts with the policy —
+        exactly as the reference-as-shipped behaves (its
+        ``forward_hydra`` reads the live trunk while only the branch
+        copies are frozen). Do not cache these logprobs across updates."""
         Q = self.query_length
         full_ids = jnp.concatenate([q_ids, r_ids], axis=1)
         full_mask = jnp.concatenate([q_mask, r_mask], axis=1)
@@ -700,17 +722,18 @@ class PPOTrainer(BaseRLTrainer):
             out_shardings=(batch_sh, rep),
         )
 
-        def train_step(state: TrainState, mb: PPORolloutBatch):
+        def train_step_with_adv(
+            state: TrainState, mb: PPORolloutBatch, advantages, returns
+        ):
             def loss_fn(params):
                 # stop_gradient on frozen leaves: XLA prunes the backward
-                # below the branch point (the dominant train-phase saving
-                # under num_layers_unfrozen, e.g. the reference
-                # test_config.yml:5 workload trains only the top 2 blocks)
+                # below the branch point (real work-avoidance when
+                # num_layers_unfrozen > 0 re-enables the reference's
+                # commented-out freezing)
                 params = stop_frozen_gradients(params, self.trainable_mask)
                 logprobs, values, entropy, moe = self._forward_logprobs_values(
                     params, mb
                 )
-                advantages, returns = self._advantages_and_returns(mb)
                 loss, stats = ppo_loss(
                     logprobs,
                     values,
@@ -749,6 +772,10 @@ class PPOTrainer(BaseRLTrainer):
             )
             return new_state, stats
 
+        def train_step(state: TrainState, mb: PPORolloutBatch):
+            advantages, returns = self._advantages_and_returns(mb)
+            return train_step_with_adv(state, mb, advantages, returns)
+
         self._train_step_jit = jax.jit(
             train_step,
             in_shardings=(self.state_shardings, batch_sh),
@@ -760,8 +787,24 @@ class PPOTrainer(BaseRLTrainer):
             """One full buffer pass in a single dispatch: flat scan over
             [n_mb * ppo_epochs] pre-repeated minibatch slices (the reference
             inner loop, `accelerate_base_model.py:253-266`, realized as
-            consecutive identical slices) — one train-step body to compile."""
-            return jax.lax.scan(train_step, state, mbs)
+            consecutive identical slices) — one train-step body to compile.
+
+            GAE/whitening is params-INDEPENDENT, so it is hoisted out of
+            the scan and computed for every minibatch in one batched pass:
+            inside the scan it was a fresh R-step sequential chain per
+            update — measured ~5 ms each (latency-, not compute-bound;
+            bench_train_audit.py) — i.e. ~29% of the faithful workload's
+            17 ms train step. vmap turns the 32 sequential chains into one
+            chain of batched steps; per-minibatch whitening semantics are
+            bitwise preserved (vmap axis = the minibatch axis the stats
+            were already computed within)."""
+            advantages, returns = jax.vmap(self._advantages_and_returns)(mbs)
+
+            def step(st, xs):
+                mb, adv, ret = xs
+                return train_step_with_adv(st, mb, adv, ret)
+
+            return jax.lax.scan(step, state, (mbs, advantages, returns))
 
         from trlx_tpu.parallel.mesh import stacked_batch_sharding
 
@@ -783,8 +826,9 @@ class PPOTrainer(BaseRLTrainer):
         )
 
     def score_ref(self, q_ids, q_mask, r_ids, r_mask):
-        # policy params only feed the (frozen) hydra trunk here — the
-        # compute-dtype copy is exact for it, and halves the read
+        # policy params only feed the hydra trunk here (the CURRENT
+        # trunk, trained or frozen per config — see _ref_logprobs) —
+        # the compute-dtype copy is exact for it, and halves the read
         return self._score_ref_jit(
             self.ref_params, self.rollout_params(), q_ids, q_mask, r_ids, r_mask
         )

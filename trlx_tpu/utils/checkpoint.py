@@ -109,12 +109,49 @@ def has_checkpoint(directory: str) -> bool:
     return any(name.isdigit() for name in os.listdir(directory))
 
 
+_MISMATCH_HINTS = (
+    # structure-mismatch phrasings from orbax's StandardRestore stack; keep
+    # these NARROW — broad words ("shape", "different") appear in unrelated
+    # IO/topology failures that must surface untranslated
+    "structure", "mismatch", "not match", "treedef",
+)
+
+
+def _structure_mismatch_error(directory: str, e: Exception) -> Optional[ValueError]:
+    """Map Orbax's deep structure-mismatch failures to an actionable error.
+
+    The optimizer-state layout is configuration-dependent: a frozen-mask
+    run (``model.num_layers_unfrozen``) stores moments only for the
+    trainable slice (``optax.masked``), and ``train.adam_moment_dtype``
+    changes the moment dtype — checkpoints written under one layout do not
+    restore into another, and Orbax surfaces that as an opaque error deep
+    in its restore stack."""
+    text = f"{type(e).__name__}: {e}".lower()
+    if not any(h in text for h in _MISMATCH_HINTS):
+        return None
+    return ValueError(
+        f"checkpoint under {directory} does not match the current "
+        "train-state structure. This likely means the optimizer-state "
+        "layout changed between the run that wrote the checkpoint and this "
+        "configuration — e.g. `model.num_layers_unfrozen` (frozen-mask "
+        "runs store moments only for the trainable slice) or "
+        "`train.adam_moment_dtype` differs. Frozen-mask layout changes are "
+        "not restorable: restore with the original configuration, or "
+        "restart the run fresh with a new checkpoint dir. If neither key "
+        f"changed, the underlying error was: {type(e).__name__}: {e}"
+    )
+
+
 def load_checkpoint(
     directory: str, abstract_state: Any
 ) -> Tuple[Any, Dict[str, Any]]:
     """Restore into the shapes/shardings of ``abstract_state`` (obtain via
     ``jax.eval_shape`` + shardings, or pass a live state of the right
-    spec). Reads the managed layout and the legacy state-dir + sidecar."""
+    spec). Reads the managed layout and the legacy state-dir + sidecar.
+    A checkpoint whose train-state structure does not match
+    ``abstract_state`` (e.g. a different freezing mask or moment dtype)
+    raises a :class:`ValueError` naming the config keys instead of Orbax's
+    opaque internal mismatch error."""
     wait_for_checkpoints()
     directory = os.path.abspath(directory)
     mgr = _manager(directory)
@@ -124,7 +161,13 @@ def load_checkpoint(
         # legacy layout only — once managed steps exist they are newer
         # (an upgraded run keeps saving next to the old 'state' dir)
         with ocp.StandardCheckpointer() as ckptr:
-            state = ckptr.restore(legacy_state, abstract_state)
+            try:
+                state = ckptr.restore(legacy_state, abstract_state)
+            except Exception as e:  # noqa: BLE001 — orbax raises many types
+                wrapped = _structure_mismatch_error(directory, e)
+                if wrapped is None:
+                    raise
+                raise wrapped from e
         metadata: Dict[str, Any] = {}
         legacy_json = os.path.join(directory, "host_state.json")
         if os.path.exists(legacy_json):
@@ -150,13 +193,19 @@ def load_checkpoint(
                 return 0.0
 
         step = max(steps, key=lambda s: (_saved_at(s), s))
-    restored = mgr.restore(
-        step,
-        args=ocp.args.Composite(
-            state=ocp.args.StandardRestore(abstract_state),
-            host_state=ocp.args.JsonRestore(),
-        ),
-    )
+    try:
+        restored = mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_state),
+                host_state=ocp.args.JsonRestore(),
+            ),
+        )
+    except Exception as e:  # noqa: BLE001 — orbax raises many types
+        wrapped = _structure_mismatch_error(directory, e)
+        if wrapped is None:
+            raise
+        raise wrapped from e
     metadata = dict(restored["host_state"] or {})
     metadata.pop("_saved_at", None)
     return restored["state"], metadata
