@@ -75,8 +75,11 @@ def main():
     prompt_mask = jnp.ones((B, Q), jnp.int32)
 
     out = trainer.sample(prompt_ids, prompt_mask)
-    full_ids = out.tokens  # [B, Q + R]
+    full_ids = out.tokens  # [B, Q + R_eff] (R_eff = bound decode budget)
+    R = full_ids.shape[1] - Q
     resp_mask = np.asarray(out.response_mask, bool)
+    if resp_mask.shape[1] == full_ids.shape[1]:
+        resp_mask = resp_mask[:, Q:]  # align with response positions
 
     backbone_params = trainer.state.params["transformer"]
     arch = trainer.model_config
@@ -123,41 +126,44 @@ def main():
     # --- latency probe: chained decode steps inside one jit ------------
     from trlx_tpu.models.gpt2 import init_cache
 
-    def step_latency(model, params, n_layers_tag):
-        C = Q + R
-        cache = init_cache(model.config, B, C)
-        ids0 = jnp.zeros((B, 1), jnp.int32)
+    def step_latency(model, params, b, q, r):
+        C = q + r
+        cache = init_cache(model.config, b, C)
+        ids0 = jnp.zeros((b, 1), jnp.int32)
 
-        def body(carry, _):
-            ids, cache = carry
-            o = model.apply(
-                {"params": params}, ids,
-                attention_mask=jnp.ones((B, C), jnp.int32),
-                cache=cache, cache_index=jnp.int32(Q),
-            )
-            nxt = jnp.argmax(o["logits"][:, -1], axis=-1)[:, None].astype(
-                jnp.int32
-            )
-            return (nxt, o["cache"]), None
+        # params are an ARGUMENT, not a closure — closed-over arrays
+        # serialize into the compile request and the tunnel rejects the
+        # 124M-param program body (HTTP 413)
+        def run(p, ids, cache):
+            def body(carry, _):
+                ids, cache = carry
+                o = model.apply(
+                    {"params": p}, ids,
+                    attention_mask=jnp.ones((b, C), jnp.int32),
+                    cache=cache, cache_index=jnp.int32(q),
+                )
+                nxt = jnp.argmax(
+                    o["logits"][:, -1], axis=-1
+                )[:, None].astype(jnp.int32)
+                return (nxt, o["cache"]), None
 
-        def run(ids, cache):
             (ids, cache), _ = jax.lax.scan(
                 body, (ids, cache), None, length=50
             )
             return ids
 
         fn = jax.jit(run)
-        r = fn(ids0, cache)
-        jax.block_until_ready(r)
+        out0 = fn(params, ids0, cache)
+        jax.block_until_ready(out0)
         best = float("inf")
         for _ in range(3):
             t0 = time.time()
-            jax.block_until_ready(fn(ids0, cache))
+            jax.block_until_ready(fn(params, ids0, cache))
             best = min(best, time.time() - t0)
         return best / 50
 
-    t_target = step_latency(trainer.backbone, backbone_params, 2)
-    t_draft = step_latency(draft_model, draft_params, 1)
+    t_target = step_latency(trainer.backbone, backbone_params, B, Q, R)
+    t_draft = step_latency(draft_model, draft_params, B, Q, R)
     # verify pass = one full-model forward over k+1 tokens with cache —
     # latency-bound, so approximate with the measured single-step target
     # latency (k tokens widen an already tiny matmul)
@@ -182,6 +188,61 @@ def main():
             "NEGATIVE: projection below 1.1x — lever stays unpulled"
         ),
     }
+
+    # --- the other half: latency ratio at the BENCH workload shape.
+    # Acceptance there is unmeasurable without a real checkpoint
+    # (random-init distributions are meaningless), but the draft/target
+    # latency ratio rho IS measurable, and with it the BREAK-EVEN
+    # acceptance curve: speculation wins iff
+    # (1 - a^(k+1)) / (1 - a) > k*rho + 1.
+    from trlx_tpu.models.registry import get_model_family as _fam
+
+    bench_arch = _fam("gpt2").config_cls.from_dict(
+        {"vocab_size": 50257, "n_positions": 1024, "n_embd": 768,
+         "n_layer": 12, "n_head": 12, "dtype": "bfloat16",
+         "kv_cache_dtype": "auto"}
+    )
+    bench_model = _fam("gpt2").backbone_cls(bench_arch)
+    draft2_arch = _fam("gpt2").config_cls.from_dict(
+        {"vocab_size": 50257, "n_positions": 1024, "n_embd": 768,
+         "n_layer": 2, "n_head": 12, "dtype": "bfloat16",
+         "kv_cache_dtype": "auto"}
+    )
+    draft2_model = _fam("gpt2").backbone_cls(draft2_arch)
+    rngk = jax.random.PRNGKey(0)
+    dummy = jnp.ones((2, 4), jnp.int32)
+    bench_params = bench_model.init(
+        rngk, dummy, attention_mask=jnp.ones_like(dummy)
+    )["params"]
+    draft2_params = {
+        k: bench_params[k] for k in ("wte", "wpe", "h_0", "h_1", "ln_f")
+    }
+
+    t_bench_target = step_latency(bench_model, bench_params, 128, 64, 48)
+    t_bench_draft = step_latency(draft2_model, draft2_params, 128, 64, 48)
+    rho = t_bench_draft / t_bench_target
+
+    def break_even_acceptance(k, rho):
+        lo, hi = 0.0, 1.0
+        for _ in range(40):
+            mid = (lo + hi) / 2
+            tokens = (k + 1) if mid >= 1 else (1 - mid ** (k + 1)) / (1 - mid)
+            if tokens > k * rho + 1:
+                hi = mid
+            else:
+                lo = mid
+        return hi
+
+    result.update(
+        {
+            "bench_shape_t_target_ms": round(t_bench_target * 1e3, 3),
+            "bench_shape_t_draft2_ms": round(t_bench_draft * 1e3, 3),
+            "bench_shape_rho": round(rho, 3),
+            "bench_shape_break_even_acceptance_by_k": {
+                k: round(break_even_acceptance(k, rho), 3) for k in K_RANGE
+            },
+        }
+    )
     print(json.dumps(result))
 
 

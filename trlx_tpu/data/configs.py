@@ -161,6 +161,16 @@ class TrainConfig:
     # stage forward per backward (the standard remat trade). v=1 only;
     # exact grad parity vs the autodiffed schedule is pinned in tests.
     pp_remat: bool = False
+    # Compute the PPO update's response logprobs in chunks of this many
+    # positions (0 = off): the LM head + log-softmax + gather run per
+    # chunk under jax.checkpoint, so the [B, R, vocab] f32 logits buffer
+    # — the train step's largest intermediate, ~5 HBM crossings
+    # (bench_train_audit.py bytes_split) — never materializes at full
+    # width; the backward recomputes each chunk's logits (one extra head
+    # matmul). Must divide gen max_new_tokens. Measured-neutral guardrail:
+    # only enable where an A/B shows a win (ab in bench_train_audit.py);
+    # entropy-bonus runs (ent_coef) fall back to the full buffer.
+    logprob_chunk: int = 0
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     # Serve the rollout phase (sampler + frozen-ref scoring) a one-time

@@ -110,11 +110,26 @@ class CausalLMWithValueHead(nn.Module):
         the query positions too would write (and backprop through) a
         [B, Q+R, vocab] float32 tensor for nothing.
         """
+        h, values = self.response_hidden(
+            input_ids, attention_mask, query_length
+        )
+        return self.backbone.logits(h), values
+
+    def response_hidden(
+        self,
+        input_ids: jax.Array,
+        attention_mask: jax.Array,
+        query_length: int,
+    ):
+        """(hidden, values) over response-predicting positions — the
+        logits-free half of :meth:`response_forward`, for callers that
+        compute logprobs chunked (``train.logprob_chunk``) instead of
+        materializing the [B, R, vocab] f32 logits buffer."""
         out = self.backbone(
             input_ids, attention_mask=attention_mask, compute_logits=False
         )
         h = out["hidden"][:, query_length - 1 : -1]
-        return self.backbone.logits(h), self.v_head(h)[..., 0]
+        return h, self.v_head(h)[..., 0]
 
     def lm_only(
         self,

@@ -200,6 +200,24 @@ class PPOTrainer(BaseRLTrainer):
             getattr(self.model_config, "vocab_size", None),
             provided=set(gen_kwargs),
         )
+        if train.logprob_chunk:
+            if train.logprob_chunk < 0:
+                raise ValueError(
+                    f"train.logprob_chunk={train.logprob_chunk} must be >= 0"
+                )
+            if not self._supports_logprob_chunk():
+                # a silently-ignored memory flag is worse than a refusal
+                raise NotImplementedError(
+                    f"train.logprob_chunk is not supported by "
+                    f"{type(self).__name__} (causal-path feature; the "
+                    f"seq2seq forward computes its own logits); remove "
+                    f"the key"
+                )
+            if self.gen_config.max_new_tokens % train.logprob_chunk:
+                raise ValueError(
+                    f"train.logprob_chunk={train.logprob_chunk} must divide "
+                    f"gen max_new_tokens={self.gen_config.max_new_tokens}"
+                )
 
         # --- params, shardings, optimizer, state ---
         self.rng, init_rng = jax.random.split(self.rng)
@@ -576,6 +594,45 @@ class PPOTrainer(BaseRLTrainer):
                 method=self.model.response_forward, mutable=["moe_losses"],
             )
             moe = moe_loss_summary(state["moe_losses"])
+        elif self._logprob_chunk_active():
+            # chunked logprob/CE (train.logprob_chunk): head + log-softmax
+            # + gather per chunk under jax.checkpoint — the full [B, R, V]
+            # f32 logits buffer never materializes; bwd recomputes each
+            # chunk's logits from its saved hidden slice
+            hidden, values = self.model.apply(
+                {"params": params}, full_ids, full_mask, Q,
+                method=self.model.response_hidden,
+            )
+            c = self.config.train.logprob_chunk
+            B, R, d = hidden.shape
+            if R % c:
+                raise ValueError(
+                    f"train.logprob_chunk={c} does not divide the bound "
+                    f"response width {R} (bind_prompt_budget shrank the "
+                    f"decode budget); pick a chunk dividing both"
+                )
+            n = R // c
+            hs = hidden.reshape(B, n, c, d).swapaxes(0, 1)  # [n, B, c, d]
+            toks = mb.response_tokens.reshape(B, n, c).swapaxes(0, 1)
+            backbone_params = params[self.backbone_key]
+
+            @jax.checkpoint
+            def chunk_logprobs(h_c, t_c):
+                logits_c = self.backbone.apply(
+                    {"params": backbone_params}, h_c,
+                    method=self.backbone.logits,
+                )
+                return logprobs_from_logits(
+                    logits_c.astype(jnp.float32), t_c
+                )
+
+            def body(carry, xs):
+                h_c, t_c = xs
+                return carry, chunk_logprobs(h_c, t_c)
+
+            _, lps = jax.lax.scan(body, None, (hs, toks))
+            logprobs = lps.swapaxes(0, 1).reshape(B, R)
+            return logprobs, values.astype(jnp.float32), None, moe
         else:
             logits, values = self.model.apply(
                 {"params": params}, full_ids, full_mask, Q,
@@ -586,6 +643,23 @@ class PPOTrainer(BaseRLTrainer):
             _policy_entropy(logits) if self.config.method.ent_coef else None
         )
         return logprobs, values.astype(jnp.float32), entropy, moe
+
+    def _supports_logprob_chunk(self) -> bool:
+        """Whether this trainer class can honor ``train.logprob_chunk``
+        at all (the seq2seq trainer overrides its forward and returns
+        False — the flag refuses loudly there instead of no-opping)."""
+        return True
+
+    def _logprob_chunk_active(self) -> bool:
+        """Chunked logprobs apply on the plain causal path only: pp has
+        its own response forward, MoE threads the sow collection through
+        response_forward, and the entropy bonus needs full-vocab terms."""
+        c = self.config.train.logprob_chunk
+        return bool(c) and not (
+            self.pp_stages > 1
+            or self._moe_family
+            or self.config.method.ent_coef
+        )
 
     def _supports_hydra(self) -> bool:
         return True

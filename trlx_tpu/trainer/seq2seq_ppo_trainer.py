@@ -68,6 +68,12 @@ class Seq2SeqPPOTrainer(PPOTrainer):
         # a compute-dtype copy would not be bit-identical — keep masters
         return False
 
+    def _supports_logprob_chunk(self) -> bool:
+        # this trainer overrides _forward_logprobs_values with its own
+        # (encoder+decoder) forward; the chunked causal path never runs,
+        # so the flag refuses at construction instead of no-opping
+        return False
+
     def _validate_pp_mesh(self, config, train) -> None:
         # pp for seq2seq: BOTH trunk stacks pipeline in the update's
         # forwards (`pp_runner.pp_t5_forward`), and (round 4) the rollout
