@@ -195,18 +195,18 @@ def main():
     # latency ratio rho IS measurable, and with it the BREAK-EVEN
     # acceptance curve: speculation wins iff
     # (1 - a^(k+1)) / (1 - a) > k*rho + 1.
+    from bench import _workload_config
     from trlx_tpu.models.registry import get_model_family as _fam
 
-    bench_arch = _fam("gpt2").config_cls.from_dict(
-        {"vocab_size": 50257, "n_positions": 1024, "n_embd": 768,
-         "n_layer": 12, "n_head": 12, "dtype": "bfloat16",
-         "kv_cache_dtype": "auto"}
+    # the EXACT bench workload arch (single source of truth) + a 2-layer
+    # shared-weight draft of it
+    bench_arch_dict = dict(
+        _workload_config(0, 2).model.model_arch, dtype="bfloat16"
     )
+    bench_arch = _fam("gpt2").config_cls.from_dict(bench_arch_dict)
     bench_model = _fam("gpt2").backbone_cls(bench_arch)
     draft2_arch = _fam("gpt2").config_cls.from_dict(
-        {"vocab_size": 50257, "n_positions": 1024, "n_embd": 768,
-         "n_layer": 2, "n_head": 12, "dtype": "bfloat16",
-         "kv_cache_dtype": "auto"}
+        dict(bench_arch_dict, n_layer=2)
     )
     draft2_model = _fam("gpt2").backbone_cls(draft2_arch)
     rngk = jax.random.PRNGKey(0)

@@ -107,18 +107,32 @@ def _train_step_bytes(d, V, L, Q, R, B, unfrozen=0):
     mirrors bench_train_audit.py). Lower bound: fused per-layer
     activations uncounted.
 
-    - weights: fwd+bwd each read the bf16 compute cast; f32 grads written;
-    - optimizer: grads read, f32 m+v read+write, f32 masters read+write
-      (frozen leaves carry no moments — scale by the trainable fraction);
+    - weights: the fwd reads the full bf16 compute cast; the bwd is
+      PRUNED below the branch point (matching `_phase_flops`), so it
+      re-reads only the unfrozen blocks + the (tied) head transpose for
+      dlogits; f32 grads written for the trainable slice;
+    - optimizer: trainable slice only (frozen leaves carry no moments and
+      take no update — the mask freezes wte/wpe + bottom blocks, so the
+      trainable slice is the unfrozen blocks + ln_f, NOT a flat fraction
+      of all params);
     - logits pipeline: the [B, R, V] f32 buffer crosses HBM ~5 times
       (head write, logsumexp read, bwd softmax rebuild+read, dlogits
       write+read into the head transpose);
-    - residual stream saved for bwd (bf16 write+read per layer).
+    - residual stream saved for bwd (bf16 write+read per unfrozen layer).
     """
-    n_params = L * (12 * d * d + 13 * d) + V * d + 2 * d
+    blocks = L * (12 * d * d + 13 * d)
+    head = V * d
+    n_params = blocks + head + 2 * d
     frac = unfrozen / L if 0 < unfrozen < L else 1.0
-    trainable = n_params * frac
-    weights = 2 * 2 * n_params + 4 * trainable
+    # all-trainable: every param (incl. wte/wpe). Frozen: unfrozen blocks
+    # + ln_f only — the mask freezes the embeddings, and the tied head
+    # weight IS the frozen wte (value head negligible)
+    trainable = n_params if frac == 1.0 else blocks * frac + 2 * d
+    weights = (
+        2 * n_params            # fwd reads the full bf16 cast
+        + 2 * (blocks * frac + head)  # pruned bwd re-reads
+        + 4 * trainable         # f32 grads written
+    )
     optimizer = 4 * trainable + 16 * trainable + 8 * trainable
     logits = 5 * B * R * V * 4
     acts = 2 * 2 * B * (Q + R) * d * (L * frac)

@@ -123,11 +123,12 @@ class Seq2SeqPPOTrainer(PPOTrainer):
         from trlx_tpu.models.registry import get_model_family
 
         if self.config.model.num_layers_unfrozen > 0:
-            # The reference never freezes T5 (`ppo_config.yml:5` uses 0 and
-            # upstream's freeze_bottom_causal_layers expects a causal
-            # `transformer.h` stack); our mask keys on causal block names
-            # (`h_<i>`), so a positive value here would silently train the
-            # FULL model while claiming to freeze — refuse instead.
+            # The reference never freezes T5 (its PPO freezing block is
+            # commented out and operates on a causal `transformer.h`
+            # stack, `accelerate_base_model.py:55-69`); our mask keys on
+            # causal block names (`h_<i>`), so a positive value here would
+            # silently train the FULL model while claiming to freeze —
+            # refuse instead.
             raise NotImplementedError(
                 "num_layers_unfrozen > 0 is not defined for the seq2seq "
                 "(encoder-decoder) family — the reference trains the full "
