@@ -91,6 +91,10 @@ def test_zero_semantics_per_path():
     maskm1 = unfrozen_param_mask(params, -1, 4, zero_freezes_all=True)
     assert all(jax.tree_util.tree_leaves(maskm1))
 
+    # k beyond the depth is a config error, not a silent negative slice
+    with pytest.raises(ValueError, match="exceeds"):
+        unfrozen_param_mask(params, 24, 4)
+
 
 def _run_steps(trainer):
     import jax
