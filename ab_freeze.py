@@ -1,12 +1,15 @@
-"""A/B: train phase with reference-faithful layer freezing vs full training.
+"""A/B: train phase with top-2 layer freezing vs full training.
 
-The reference's gpt2 ppo_sentiments workload (`test_config.yml:5`) sets
-``num_layers_unfrozen: 2`` — only the top 2 blocks + heads train. Rounds
-1-3 benched full 12-layer training (strictly more work than the
-reference's workload definition). Round 4 made freezing real
-work-avoidance: stop_gradient on frozen leaves (XLA dead-code-eliminates
-the backward below the branch point) and optax.masked moments (frozen
-params carry no optimizer state or Adam traffic).
+(r5 correction of this header's claim: the reference as SHIPPED trains
+all 12 layers — its PPO freezing block is commented out,
+`accelerate_base_model.py:55-69`; `test_config.yml:5`'s
+``num_layers_unfrozen: 2`` only sizes the hydra KL-ref branch. Full
+training is therefore the FAITHFUL workload and the bench headline;
+freezing is the work-avoidance capability this file measures the delta
+of.) Round 4 made freezing real work-avoidance: stop_gradient on frozen
+leaves (XLA dead-code-eliminates the backward below the branch point)
+and optax.masked moments (frozen params carry no optimizer state or
+Adam traffic).
 
 This measures that delta in ONE session with the interleaved methodology
 (bench_longctx.py / MEMORY.md): one trainer, the freezing swapped in
