@@ -208,6 +208,85 @@ def test_chunked_logprobs_match_full_buffer():
     assert chunk_t < 0.7 * full_t, (chunk_t, full_t)
 
 
+def test_chunked_logprobs_compose_with_grpo_and_freezing():
+    """`train.logprob_chunk` composes with the GRPO trainer (inherits the
+    causal forward; no value function) and with bottom-layer freezing
+    (stop_frozen_gradients runs upstream of the chunked head): the full
+    grouped update step executes and frozen leaves stay bit-identical."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.data.ppo_types import PPORolloutBatch
+    from trlx_tpu.utils.loading import get_trainer
+
+    os.environ["WANDB_DISABLED"] = "1"
+    # build from_dict so method really dispatches to GRPOConfig —
+    # config.update(method={"name": ...}) would only RENAME the existing
+    # PPOConfig and bypass the isinstance-based trainer guards
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {
+                "model_type": "gpt2",
+                "num_layers_unfrozen": 1,
+                "model_arch": {
+                    "vocab_size": 64, "n_positions": 16, "n_embd": 16,
+                    "n_layer": 2, "n_head": 2,
+                },
+            },
+            "train": {
+                "seq_length": 2, "batch_size": 16, "epochs": 2,
+                "total_steps": 8, "eval_interval": 1000,
+                "checkpoint_interval": 10000, "logprob_chunk": 3,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1}, "dtype": "float32",
+            },
+            "method": {
+                "name": "GRPOConfig", "group_size": 8, "vf_coef": 0.0,
+                "num_rollouts": 32, "chunk_size": 16,
+                "gen_kwargs": {"max_new_tokens": 6, "do_sample": True,
+                               "eos_token_id": 62, "pad_token_id": 63},
+            },
+        }
+    )
+    assert type(config.method).__name__ == "GRPOConfig"
+    t = get_trainer("GRPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+    assert t._logprob_chunk_active()
+    before = jax.device_get(t.state.params)
+
+    rng = np.random.default_rng(5)
+    B, Q, R = 16, 2, 6
+    mb = PPORolloutBatch(
+        query_tokens=jnp.asarray(rng.integers(1, 60, (B, Q)), jnp.int32),
+        query_mask=jnp.ones((B, Q), jnp.int32),
+        response_tokens=jnp.asarray(
+            rng.integers(1, 60, (B, R)), jnp.int32
+        ),
+        response_mask=jnp.ones((B, R), jnp.int32),
+        logprobs=jnp.asarray(rng.normal(size=(B, R)) - 4, jnp.float32),
+        values=jnp.zeros((B, R), jnp.float32),
+        # GRPO stores group-normalized advantages in the rewards slot
+        rewards=jnp.asarray(rng.normal(size=(B, R)) * 0.3, jnp.float32),
+    )
+    t.state, stats = t._train_step_jit(t.state, mb)
+    after = jax.device_get(t.state.params)
+    flat_mask = dict(jax.tree_util.tree_leaves_with_path(t.trainable_mask))
+    flat_before = dict(jax.tree_util.tree_leaves_with_path(before))
+    moved_frozen, moved_trainable = [], []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(after):
+        b = flat_before[path]
+        moved = not np.array_equal(np.asarray(leaf), np.asarray(b))
+        (moved_trainable if flat_mask[path] else moved_frozen).append(
+            (jax.tree_util.keystr(path), moved)
+        )
+    assert not [p for p, m in moved_frozen if m]
+    assert any(m for _, m in moved_trainable)
+    assert all(
+        bool(np.isfinite(np.asarray(v)).all())
+        for v in jax.tree_util.tree_leaves(jax.device_get(stats))
+    )
+
+
 def test_training_runs_and_stats_finite(trained):
     import jax
 
