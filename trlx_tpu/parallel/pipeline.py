@@ -468,8 +468,14 @@ def pipeline_apply_remat(
     Peak residual memory drops from O(span · per-layer internals) to
     O(M stage inputs) per device + one stage's recompute working set.
 
-    v=1, cache-less, train-schedule only. Gradient parity vs the
-    autodiffed schedule is pinned in ``tests/test_pipeline_parallel.py``.
+    v=1, cache-less, train-schedule only. Non-inexact leaves (int32
+    rotary position_ids in aux, gpt_neo's bool band flags in the stage
+    tree) ride to the recompute via closure and receive float0
+    cotangents at the custom_vjp boundary (round 5). Gradient parity vs
+    the autodiffed schedule is pinned in
+    ``tests/test_pipeline_parallel.py`` and, per causal family,
+    ``tests/test_pp_integration.py::
+    test_pp_remat_matches_autodiff_nonfloat_leaves``.
     """
     S = mesh.shape[axis_name]
     M = num_microbatches
