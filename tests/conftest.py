@@ -6,6 +6,7 @@ sharding paths execute real collectives.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -15,12 +16,50 @@ if "xla_force_host_platform_device_count" not in flags:
 # collective rendezvous hard-aborts the whole process (rendezvous.cc
 # Check failure -> SIGABRT) if any participant thread is starved past the
 # default 40 s — which under host load is a matter of luck. Raise the
-# termination timeout so slow is slow, not fatal.
+# termination timeout so slow is slow, not fatal. XLA also hard-aborts on
+# *unknown* XLA_FLAGS at backend init, so only pass the flag when this
+# jaxlib knows it (probed in a throwaway subprocess — the abort is fatal
+# and cannot be caught in-process).
 if "collective_call_terminate_timeout" not in flags:
-    flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
-os.environ["XLA_FLAGS"] = flags
+    import subprocess
+    import tempfile
 
-import sys
+    try:
+        import jaxlib.version
+
+        _jaxlib_ver = jaxlib.version.__version__
+    except Exception:
+        _jaxlib_ver = "unknown"
+    # the probe costs a full jax import + backend init in a child process;
+    # cache its verdict per jaxlib version so only the first pytest run pays
+    _cache = os.path.join(
+        tempfile.gettempdir(), f".trlx_tpu_xla_flag_probe_{_jaxlib_ver}"
+    )
+    if os.path.exists(_cache):
+        with open(_cache) as fh:
+            _flag_ok = fh.read().strip() == "1"
+    else:
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env={
+                    **os.environ,
+                    "XLA_FLAGS": "--xla_cpu_collective_call_terminate_timeout_seconds=600",
+                },
+                capture_output=True,
+                timeout=120,
+            )
+            _flag_ok = probe.returncode == 0
+        except subprocess.TimeoutExpired:
+            _flag_ok = False
+        try:
+            with open(_cache, "w") as fh:
+                fh.write("1" if _flag_ok else "0")
+        except OSError:
+            pass
+    if _flag_ok:
+        flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+os.environ["XLA_FLAGS"] = flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,67 @@
+"""Static analysis for the TPU port: jaxpr audit + AST lint.
+
+Two engines enforce the invariants the reference kept by convention
+(bf16 compute / f32 optimizer, frozen KL reference, declared-collective
+parallelism) and the host-sync discipline OPPO/HEPPO-GAE (PAPERS.md) show
+PPO throughput hinges on:
+
+- :mod:`trlx_tpu.analysis.jaxpr_audit` — traces the trainers' jitted
+  step/rollout programs abstractly on a CPU mesh and walks the jaxprs.
+- :mod:`trlx_tpu.analysis.ast_lint` — rule-based source checker for
+  host-sync / tracer-safety hazards in traced Python code.
+
+Run ``python -m trlx_tpu.analysis --help`` or see docs/static_analysis.md.
+"""
+
+from trlx_tpu.analysis.findings import (
+    Finding,
+    Report,
+    filter_suppressed,
+)
+from trlx_tpu.analysis.registry import Rule, all_rules, get_rule, register_rule
+
+__all__ = [
+    "Finding",
+    "Report",
+    "Rule",
+    "all_rules",
+    "filter_suppressed",
+    "get_rule",
+    "register_rule",
+    "run",
+]
+
+
+def run(
+    engine: str = "all",
+    paths=None,
+    trainers=None,
+) -> Report:
+    """Run the selected engine(s); returns a merged :class:`Report`.
+
+    :param engine: ``all`` | ``jaxpr`` | ``ast``.
+    :param paths: files/dirs for the AST lint (default: the trlx_tpu
+        package directory).
+    :param trainers: trainer kinds for the jaxpr audit (default: all four).
+    """
+    import os
+
+    report = Report()
+    if engine in ("all", "ast"):
+        from trlx_tpu.analysis.ast_lint import lint_paths
+
+        default_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        findings, covered, suppressed = lint_paths(paths or [default_root])
+        report.extend(findings)
+        report.covered += covered
+        report.suppressed += suppressed
+    if engine in ("all", "jaxpr"):
+        from trlx_tpu.analysis.jaxpr_audit import audit_trainers
+
+        sub = audit_trainers(trainers)
+        report.extend(sub.findings)
+        report.covered += sub.covered
+        report.suppressed += sub.suppressed
+    return report

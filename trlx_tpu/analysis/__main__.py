@@ -1,0 +1,85 @@
+"""CLI: ``python -m trlx_tpu.analysis [--strict] [--json] ...``.
+
+Exit status: 0 when clean; 1 when findings remain (``--strict`` counts
+warnings too, plain mode only errors). Designed for CI on CPU-only
+runners — the jaxpr audit forces an 8-virtual-device CPU platform before
+JAX initializes so collective/sharding structure is real.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu_platform() -> None:
+    """Make the audit runnable on any host, before jax first initializes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.analysis",
+        description="jaxpr + AST static analysis for the TPU port",
+    )
+    parser.add_argument(
+        "--engine",
+        choices=("all", "jaxpr", "ast"),
+        default="all",
+        help="which engine(s) to run (default: all)",
+    )
+    parser.add_argument(
+        "--paths",
+        nargs="*",
+        default=None,
+        help="files/dirs for the AST lint (default: the trlx_tpu package)",
+    )
+    parser.add_argument(
+        "--trainers",
+        default=None,
+        help="comma-separated trainer kinds for the jaxpr audit "
+        "(default: ppo,ilql,grpo,seq2seq)",
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="fail on any finding, warnings included",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from trlx_tpu.analysis.registry import all_rules
+
+        for rule in all_rules():
+            print(f"{rule.id:18} [{rule.engine}/{rule.severity}] "
+                  f"{rule.description}")
+        return 0
+
+    if args.engine in ("all", "jaxpr"):
+        _force_cpu_platform()
+
+    from trlx_tpu.analysis import run
+
+    trainers = (
+        [t.strip() for t in args.trainers.split(",") if t.strip()]
+        if args.trainers
+        else None
+    )
+    report = run(engine=args.engine, paths=args.paths, trainers=trainers)
+    print(report.to_json() if args.json else report.format_text())
+    return report.exit_code(strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,493 @@
+"""AST lint: host-sync and tracer-safety hazards in traced Python code.
+
+The jaxpr audit (engine 1) sees what actually traced; this engine sees what
+*would* trace — every function that is jit-decorated, passed to a JAX
+transform (``jax.jit``/``lax.scan``/``shard_map``/...), defined inside such
+a function, or statically reachable from one via same-module calls. Inside
+that traced region it flags operations that either fail under tracing or
+smuggle in a device->host synchronization:
+
+- ``host-item``: ``x.item()``
+- ``host-scalar-cast``: ``float(x)`` / ``int(x)`` of a non-literal
+  (shape arithmetic — subtrees mentioning ``.shape``/``len(``/``.ndim`` —
+  is static under trace and exempt)
+- ``host-transfer``: ``jax.device_get`` / ``np.asarray`` / ``np.array`` /
+  ``.block_until_ready()``
+- ``py-random``: the Python ``random`` module or ``np.random``
+
+Plus one scope rule: ``np-in-ops`` — inside ``trlx_tpu/ops/`` every
+function body must use ``jnp``, not ``np`` (ops/ is kernel code; its
+functions run under trace by contract even when this file cannot prove it).
+
+The traced-region computation is a static over/under-approximation: calls
+through containers, getattr strings, or cross-module helpers are not
+followed. False positives are silenced inline with
+``# tpu-lint: disable=<rule>`` (see docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from trlx_tpu.analysis.findings import Finding, filter_suppressed
+from trlx_tpu.analysis.registry import get_rule
+
+# Dotted-name forms whose call (or decorator) makes function arguments /
+# the decorated function traced. Bare trailing names are accepted only for
+# unambiguous JAX spellings.
+_TRACE_ENTRY_EXACT = {
+    "jit", "pjit", "vmap", "pmap", "shard_map", "value_and_grad",
+    "make_jaxpr", "eval_shape",
+}
+_TRACE_ENTRY_DOTTED_SUFFIX = (
+    "jax.jit", "jax.pjit", "jax.vmap", "jax.pmap", "jax.grad",
+    "jax.value_and_grad", "jax.checkpoint", "jax.remat", "jax.eval_shape",
+    "jax.make_jaxpr", "jax.custom_jvp", "jax.custom_vjp",
+    "lax.scan", "lax.cond", "lax.while_loop", "lax.fori_loop",
+    "lax.switch", "lax.map", "lax.associative_scan",
+    "shard_map.shard_map",
+)
+
+_NUMPY_MODULES = {"numpy"}
+_RANDOM_MODULES = {"random"}
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' for Attribute chains / Names; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ImportAliases(ast.NodeVisitor):
+    """Map local alias -> canonical module for numpy / random / jax."""
+
+    def __init__(self) -> None:
+        self.numpy: Set[str] = set()
+        self.random: Set[str] = set()
+        self.jax: Set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            top = alias.name.split(".")[0]
+            local = (alias.asname or alias.name).split(".")[0]
+            if top in _NUMPY_MODULES:
+                self.numpy.add(local)
+            elif top in _RANDOM_MODULES:
+                self.random.add(local)
+            elif top == "jax":
+                self.jax.add(local)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and node.module.split(".")[0] in _NUMPY_MODULES:
+            for alias in node.names:
+                # `from numpy import asarray as aa` — track the bare name
+                self.numpy.add(alias.asname or alias.name)
+
+
+def _is_trace_entry(func: ast.AST, aliases: _ImportAliases) -> bool:
+    name = _dotted_name(func)
+    if name is None:
+        return False
+    if name in _TRACE_ENTRY_EXACT:
+        return True
+    for suffix in _TRACE_ENTRY_DOTTED_SUFFIX:
+        if name == suffix or name.endswith("." + suffix):
+            return True
+    # alias-aware: `import jax as j` -> j.jit
+    root = name.split(".")[0]
+    rest = name[len(root):]
+    if rest and root != "jax" and root in aliases.jax:
+        return _is_trace_entry(
+            ast.parse("jax" + rest, mode="eval").body, aliases
+        )
+    return False
+
+
+def _callable_arg_names(call: ast.Call) -> List[str]:
+    """Names of function-valued arguments: bare names and self.<attr>."""
+    out: List[str] = []
+    args: List[ast.AST] = list(call.args) + [kw.value for kw in call.keywords]
+    for a in args:
+        if isinstance(a, ast.Name):
+            out.append(a.id)
+        elif isinstance(a, ast.Attribute):
+            # self._ref_logprobs / cls.step — record the attribute name
+            out.append(a.attr)
+        elif isinstance(a, ast.Call):
+            # functools.partial(fn, ...) — the wrapped fn is the entry
+            out.extend(_callable_arg_names(a))
+    return out
+
+
+class _FunctionIndex(ast.NodeVisitor):
+    """Per-module index: function defs, call edges, traced roots."""
+
+    def __init__(self, aliases: _ImportAliases) -> None:
+        self.aliases = aliases
+        self.defs: Dict[str, List[ast.AST]] = {}  # name -> def nodes
+        self.calls: Dict[str, Set[str]] = {}  # caller name -> callee names
+        self.traced_roots: Set[str] = set()
+        self._stack: List[str] = []
+
+    def _handle_def(self, node) -> None:
+        self.defs.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _is_trace_entry(target, self.aliases):
+                self.traced_roots.add(node.name)
+            elif isinstance(dec, ast.Call):
+                # functools.partial(jax.jit, ...) as a decorator
+                for a in list(dec.args) + [k.value for k in dec.keywords]:
+                    if _is_trace_entry(a, self.aliases):
+                        self.traced_roots.add(node.name)
+        if self._stack:
+            # record nesting as a call edge: if the outer fn is traced,
+            # everything it defines traces with it
+            self.calls.setdefault(self._stack[-1], set()).add(node.name)
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _handle_def
+    visit_AsyncFunctionDef = _handle_def
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_trace_entry(node.func, self.aliases):
+            for name in _callable_arg_names(node):
+                self.traced_roots.add(name)
+        if self._stack:
+            callee = _dotted_name(node.func)
+            if callee:
+                # record both `helper(...)` and `self.helper(...)` edges
+                self.calls.setdefault(self._stack[-1], set()).add(
+                    callee.split(".")[-1]
+                )
+        self.generic_visit(node)
+
+
+def _transitively_traced(index: _FunctionIndex) -> Set[str]:
+    traced = set(index.traced_roots)
+    frontier = list(traced)
+    while frontier:
+        name = frontier.pop()
+        for callee in index.calls.get(name, ()):
+            if callee in index.defs and callee not in traced:
+                traced.add(callee)
+                frontier.append(callee)
+    return traced
+
+
+def _collect_static_names(func_node: ast.AST) -> Set[str]:
+    """Names bound from shape metadata inside a function body — static
+    under trace (``B, T = x.shape``, ``n = len(xs)``, ``d = x.ndim``)."""
+    static: Set[str] = set()
+    for sub in ast.walk(func_node):
+        if not isinstance(sub, ast.Assign):
+            continue
+        value = sub.value
+        is_static_value = (
+            (isinstance(value, ast.Attribute) and value.attr in (
+                "shape", "ndim", "size",
+            ))
+            or (
+                isinstance(value, ast.Subscript)
+                and isinstance(value.value, ast.Attribute)
+                and value.value.attr == "shape"
+            )
+            or (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "len"
+            )
+        )
+        if not is_static_value:
+            continue
+        for target in sub.targets:
+            names = (
+                target.elts
+                if isinstance(target, (ast.Tuple, ast.List))
+                else [target]
+            )
+            for n in names:
+                if isinstance(n, ast.Name):
+                    static.add(n.id)
+    return static
+
+
+def _is_static_expr(node: ast.AST, static_names: Set[str]) -> bool:
+    """True when every name the expression reads is statically known under
+    trace: constants, shape-derived locals, `self`/`cls` attribute reads
+    (host config), and .shape/.ndim/len() accesses."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if sub.id not in static_names and sub.id not in (
+                "self", "cls", "len", "min", "max",
+            ):
+                # a Name that is only the base of a .shape/.ndim read is
+                # fine — handled by the Attribute branch marking it used
+                if not _only_feeds_shape_reads(sub, node):
+                    return False
+        elif isinstance(sub, ast.Call):
+            func = sub.func
+            ok_call = isinstance(func, ast.Name) and func.id in (
+                "len", "min", "max", "int", "float", "abs",
+            )
+            if not ok_call:
+                return False
+    return True
+
+
+def _only_feeds_shape_reads(name: ast.Name, root: ast.AST) -> bool:
+    """Whether ``name`` appears in ``root`` only as `<name>.shape` /
+    `<name>.ndim` / `<name>.size` / `len(<name>)`."""
+    for sub in ast.walk(root):
+        if isinstance(sub, ast.Attribute) and sub.value is name:
+            return sub.attr in ("shape", "ndim", "size")
+        if isinstance(sub, ast.Call) and name in sub.args and isinstance(
+            sub.func, ast.Name
+        ) and sub.func.id == "len":
+            return True
+    return False
+
+
+class _TracedBodyLinter(ast.NodeVisitor):
+    """Flags host-sync / tracer hazards inside one traced function body."""
+
+    def __init__(
+        self,
+        path: str,
+        subject: str,
+        aliases: _ImportAliases,
+        static_names: Optional[Set[str]] = None,
+    ) -> None:
+        self.path = path
+        self.subject = subject
+        self.aliases = aliases
+        self.static_names = static_names or set()
+        self.findings: List[Finding] = []
+
+    def _add(self, rule_id: str, node: ast.AST, message: str) -> None:
+        rule = get_rule(rule_id)
+        self.findings.append(
+            Finding(
+                rule=rule.id,
+                message=message,
+                severity=rule.severity,
+                file=self.path,
+                line=getattr(node, "lineno", None),
+                subject=self.subject,
+                engine="ast",
+            )
+        )
+
+    def visit_FunctionDef(self, node) -> None:
+        # nested defs are traced with the parent — keep walking
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not node.args:
+                self._add(
+                    "host-item", node,
+                    ".item() inside traced code forces a device->host sync",
+                )
+            elif func.attr == "block_until_ready":
+                self._add(
+                    "host-transfer", node,
+                    ".block_until_ready() inside traced code is a host sync",
+                )
+            dotted = _dotted_name(func)
+            if dotted:
+                root, leaf = dotted.split(".")[0], dotted.split(".")[-1]
+                if leaf == "device_get" and root in (
+                    self.aliases.jax | {"jax"}
+                ):
+                    self._add(
+                        "host-transfer", node,
+                        "jax.device_get inside traced code pulls the value "
+                        "to host every trace",
+                    )
+                elif leaf in ("asarray", "array", "copy") and root in (
+                    self.aliases.numpy | {"np", "numpy"}
+                ):
+                    self._add(
+                        "host-transfer", node,
+                        f"{dotted} materializes a host array inside traced "
+                        "code; use jnp",
+                    )
+        elif isinstance(func, ast.Name) and func.id in ("float", "int"):
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                if not _is_static_expr(node.args[0], self.static_names):
+                    self._add(
+                        "host-scalar-cast", node,
+                        f"{func.id}() of a traced value concretizes it on "
+                        "host; use .astype()/jnp casts",
+                    )
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        dotted = _dotted_name(node)
+        if dotted:
+            parts = dotted.split(".")
+            # only names the module actually bound to Python's `random`
+            # count — `from jax import random` is device RNG, not a hazard
+            if parts[0] in self.aliases.random and len(parts) > 1:
+                self._add(
+                    "py-random", node,
+                    "Python `random` in traced code bakes one sample into "
+                    "the compiled program; use jax.random",
+                )
+            elif (
+                len(parts) > 2
+                and parts[0] in (self.aliases.numpy | {"np", "numpy"})
+                and parts[1] == "random"
+            ):
+                self._add(
+                    "py-random", node,
+                    "np.random in traced code bakes one sample into the "
+                    "compiled program; use jax.random",
+                )
+        self.generic_visit(node)
+
+
+class _OpsNumpyLinter(ast.NodeVisitor):
+    """np-in-ops: no `np.` inside any function body of an ops/ module."""
+
+    def __init__(self, path: str, aliases: _ImportAliases) -> None:
+        self.path = path
+        self.aliases = aliases
+        self.findings: List[Finding] = []
+        self._depth = 0
+
+    def _handle_def(self, node) -> None:
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    visit_FunctionDef = _handle_def
+    visit_AsyncFunctionDef = _handle_def
+    visit_Lambda = _handle_def
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if self._depth > 0 and node.id in (self.aliases.numpy | {"np"}):
+            rule = get_rule("np-in-ops")
+            self.findings.append(
+                Finding(
+                    rule=rule.id,
+                    message="ops/ kernel code must use jnp, not np (host "
+                    "numpy escapes the trace)",
+                    severity=rule.severity,
+                    file=self.path,
+                    line=node.lineno,
+                    subject=os.path.basename(self.path),
+                    engine="ast",
+                )
+            )
+        self.generic_visit(node)
+
+
+def lint_source(
+    source: str, path: str, is_ops_module: Optional[bool] = None
+) -> Tuple[List[Finding], int]:
+    """Lint one module's source; returns (non-suppressed findings,
+    number of findings silenced by inline directives)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="host-transfer",  # arbitrary carrier; syntax is fatal
+                message=f"cannot parse: {e.msg}",
+                file=path,
+                line=e.lineno,
+                engine="ast",
+            )
+        ], 0
+    aliases = _ImportAliases()
+    aliases.visit(tree)
+
+    index = _FunctionIndex(aliases)
+    index.visit(tree)
+    traced = _transitively_traced(index)
+
+    findings: List[Finding] = []
+    for name in sorted(traced):
+        for node in index.defs.get(name, ()):
+            linter = _TracedBodyLinter(
+                path, f"{name}()", aliases, _collect_static_names(node)
+            )
+            for stmt in node.body:
+                linter.visit(stmt)
+            findings.extend(linter.findings)
+
+    # lambdas passed directly to trace entries (no named def to index)
+    class _LambdaArgs(ast.NodeVisitor):
+        def visit_Call(self, call: ast.Call) -> None:
+            if _is_trace_entry(call.func, aliases):
+                for a in list(call.args) + [k.value for k in call.keywords]:
+                    if isinstance(a, ast.Lambda):
+                        linter = _TracedBodyLinter(path, "<lambda>", aliases)
+                        linter.visit(a.body)
+                        findings.extend(linter.findings)
+            self.generic_visit(call)
+
+    _LambdaArgs().visit(tree)
+
+    if is_ops_module is None:
+        is_ops_module = f"{os.sep}ops{os.sep}" in path or path.startswith(
+            "ops" + os.sep
+        )
+    if is_ops_module:
+        ops_linter = _OpsNumpyLinter(path, aliases)
+        ops_linter.visit(tree)
+        findings.extend(ops_linter.findings)
+
+    # de-duplicate (a nested def reachable via two paths lints once)
+    seen = set()
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.file, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+
+    return filter_suppressed(unique, {path: source.splitlines()})
+
+
+def lint_paths(
+    paths: Iterable[str],
+) -> Tuple[List[Finding], List[str], int]:
+    """Lint Python files / directory trees; returns
+    (findings, covered files, suppressed count)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(root, n))
+        elif p.endswith(".py"):
+            files.append(p)
+    findings: List[Finding] = []
+    n_suppressed = 0
+    for f in files:
+        try:
+            with open(f, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        found, suppressed = lint_source(source, f)
+        findings.extend(found)
+        n_suppressed += suppressed
+    return findings, files, n_suppressed

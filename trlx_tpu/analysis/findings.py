@@ -1,0 +1,147 @@
+"""Finding model + suppression + rendering for the static-analysis pass.
+
+A ``Finding`` is one rule violation at (optionally) a source location.
+Findings are machine-readable (``to_dict`` -> JSON) and human-readable
+(``format_text``). Suppression is source-inline:
+
+    x = stats["loss"].item()  # tpu-lint: disable=host-item
+
+A directive names one or more comma-separated rule ids (or ``all``) and
+silences findings of those rules **on that line only** — both engines
+funnel through :func:`filter_suppressed`, so jaxpr-audit findings that
+carry a source location honor the same syntax as AST-lint findings.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+_DIRECTIVE_RE = re.compile(r"#\s*tpu-lint:\s*disable=([\w\-,\s]+)")
+
+
+@dataclass
+class Finding:
+    """One rule violation.
+
+    :param rule: registry id of the violated rule (e.g. ``host-item``).
+    :param message: human sentence describing the violation.
+    :param severity: ``error`` (fails the run) or ``warning`` (fails only
+        under ``--strict``).
+    :param file: repo-relative path when the finding anchors to source.
+    :param line: 1-indexed line within ``file``.
+    :param subject: what was analyzed — a traced program name
+        (``ppo.train_step``), a param path, or a module path.
+    :param engine: ``jaxpr`` or ``ast``.
+    """
+
+    rule: str
+    message: str
+    severity: str = SEVERITY_ERROR
+    file: Optional[str] = None
+    line: Optional[int] = None
+    subject: Optional[str] = None
+    engine: str = "ast"
+
+    def to_dict(self) -> Dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+            "file": self.file,
+            "line": self.line,
+            "subject": self.subject,
+            "engine": self.engine,
+        }
+
+    def format_text(self) -> str:
+        loc = ""
+        if self.file:
+            loc = f"{self.file}:{self.line or '?'}: "
+        subj = f" [{self.subject}]" if self.subject else ""
+        return f"{loc}{self.severity}: {self.message} ({self.rule}){subj}"
+
+
+@dataclass
+class Report:
+    """All findings of one analysis run, plus what was covered."""
+
+    findings: List[Finding] = field(default_factory=list)
+    covered: List[str] = field(default_factory=list)  # traced programs / files
+    suppressed: int = 0
+
+    def extend(self, findings: Sequence[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == SEVERITY_ERROR]
+
+    def exit_code(self, strict: bool = False) -> int:
+        if strict:
+            return 1 if self.findings else 0
+        return 1 if self.errors() else 0
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "findings": [f.to_dict() for f in self.findings],
+                "covered": self.covered,
+                "suppressed": self.suppressed,
+            },
+            indent=2,
+        )
+
+    def format_text(self) -> str:
+        lines = [f.format_text() for f in self.findings]
+        lines.append(
+            f"tpu-lint: {len(self.findings)} finding(s) "
+            f"({len(self.errors())} error(s), {self.suppressed} suppressed) "
+            f"across {len(self.covered)} subject(s)"
+        )
+        return "\n".join(lines)
+
+
+def suppressed_rules_on_line(source_line: str) -> Optional[set]:
+    """Rule ids disabled by an inline directive on ``source_line``;
+    ``None`` when the line has no directive."""
+    m = _DIRECTIVE_RE.search(source_line)
+    if not m:
+        return None
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def filter_suppressed(
+    findings: Sequence[Finding],
+    source_lines: Optional[Dict[str, List[str]]] = None,
+) -> tuple:
+    """Split findings into (kept, n_suppressed) honoring inline directives.
+
+    ``source_lines`` maps file path -> list of lines; files not present are
+    read lazily from disk (and skipped when unreadable, keeping the finding).
+    """
+    cache: Dict[str, List[str]] = dict(source_lines or {})
+    kept: List[Finding] = []
+    n_suppressed = 0
+    for f in findings:
+        if f.file is None or f.line is None:
+            kept.append(f)
+            continue
+        if f.file not in cache:
+            try:
+                with open(f.file, encoding="utf-8") as fh:
+                    cache[f.file] = fh.read().splitlines()
+            except OSError:
+                cache[f.file] = []
+        lines = cache[f.file]
+        if 1 <= f.line <= len(lines):
+            rules = suppressed_rules_on_line(lines[f.line - 1])
+            if rules is not None and (f.rule in rules or "all" in rules):
+                n_suppressed += 1
+                continue
+        kept.append(f)
+    return kept, n_suppressed

@@ -1,0 +1,302 @@
+"""Audit harness: build tiny trainers on a CPU mesh and trace their
+jitted programs abstractly.
+
+The jaxpr audit needs *real* trainer-constructed programs — the same
+``_train_step_jit`` / ``_sample_jit`` callables production uses — traced
+with ``jax.make_jaxpr`` on shape-only inputs. This module owns the tiny
+configs (bf16 compute / f32 params, the production default, so the
+precision-leak rule sees the real dtype story) and the abstract input
+construction for all four trainers:
+
+- ``ppo``      — ``PPOTrainer``          (causal gpt2)
+- ``ilql``     — ``ILQLTrainer``         (causal gpt2)
+- ``grpo``     — ``GRPOTrainer``         (causal gpt2, grouped rollouts)
+- ``seq2seq``  — ``Seq2SeqPPOTrainer``   (T5)
+
+Runs on any device count: the audit mesh uses ``tp=2``/``fsdp=2`` when the
+host exposes enough (virtual) devices — ``python -m trlx_tpu.analysis``
+forces 8 virtual CPU devices before importing jax — and degrades to
+single-axis otherwise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set
+
+TRAINER_KINDS = ("ppo", "ilql", "grpo", "seq2seq")
+
+
+def audit_mesh_config() -> Dict[str, int]:
+    """Mesh axis sizes for the audit, adapted to the device count."""
+    import jax
+
+    n = len(jax.devices())
+    tp = 2 if n % 2 == 0 and n >= 2 else 1
+    fsdp = 2 if n % (2 * tp) == 0 and n >= 2 * tp else 1
+    return {"dp": -1, "fsdp": fsdp, "tp": tp}
+
+
+def audit_mesh():
+    from trlx_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(audit_mesh_config())
+
+
+_CAUSAL_ARCH = {
+    "vocab_size": 32,
+    "n_positions": 32,
+    "n_embd": 32,
+    "n_layer": 2,
+    "n_head": 2,
+}
+
+_T5_ARCH = {
+    "vocab_size": 32,
+    "d_model": 32,
+    "d_kv": 8,
+    "d_ff": 64,
+    "num_layers": 2,
+    "num_decoder_layers": 2,
+    "num_heads": 4,
+    "relative_attention_num_buckets": 8,
+    "relative_attention_max_distance": 16,
+    "feed_forward_proj": "gated-gelu",
+    "tie_word_embeddings": False,
+}
+
+
+def _base_train(mesh: Dict[str, int]) -> Dict[str, Any]:
+    return {
+        "seq_length": 8,
+        "batch_size": 8,
+        "epochs": 1,
+        "total_steps": 4,
+        "eval_interval": 1000,
+        "checkpoint_interval": 100000,
+        "mesh": mesh,
+        # production defaults: bf16 compute over f32 masters — the
+        # precision-leak rule audits the dtype story the TPU runs
+        "dtype": "bfloat16",
+        "param_dtype": "float32",
+    }
+
+
+def tiny_config_dict(kind: str, mesh: Optional[Dict[str, int]] = None) -> Dict:
+    mesh = dict(mesh or audit_mesh_config())
+    train = _base_train(mesh)
+    if kind in ("ppo", "grpo"):
+        method: Dict[str, Any] = {
+            "name": "GRPOConfig" if kind == "grpo" else "PPOConfig",
+            "num_rollouts": 8,
+            "chunk_size": 8,
+            "ppo_epochs": 1,
+            "init_kl_coef": 0.02,
+            "gen_kwargs": {
+                "max_new_tokens": 6,
+                "do_sample": True,
+                "eos_token_id": 30,
+                "pad_token_id": 31,
+            },
+        }
+        if kind == "grpo":
+            method["group_size"] = 4
+            train["trainer"] = "GRPOTrainer"
+        return {
+            "model": {"model_type": "gpt2", "model_arch": dict(_CAUSAL_ARCH)},
+            "train": train,
+            "method": method,
+        }
+    if kind == "ilql":
+        train["trainer"] = "ILQLTrainer"
+        train["orchestrator"] = "OfflineOrchestrator"
+        return {
+            "model": {"model_type": "gpt2", "model_arch": dict(_CAUSAL_ARCH)},
+            "train": train,
+            "method": {
+                "name": "ILQLConfig",
+                "gen_kwargs": {
+                    "max_new_tokens": 6,
+                    "do_sample": False,
+                    "eos_token_id": 30,
+                    "pad_token_id": 31,
+                },
+            },
+        }
+    if kind == "seq2seq":
+        train["trainer"] = "Seq2SeqPPOTrainer"
+        return {
+            "model": {"model_type": "t5", "model_arch": dict(_T5_ARCH)},
+            "train": train,
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 8,
+                "chunk_size": 8,
+                "ppo_epochs": 1,
+                "init_kl_coef": 0.02,
+                "gen_kwargs": {
+                    "max_new_tokens": 5,
+                    "do_sample": True,
+                    "eos_token_id": 1,
+                    "pad_token_id": 0,
+                    "decoder_start_token_id": 0,
+                },
+            },
+        }
+    raise ValueError(f"unknown trainer kind {kind!r}; know {TRAINER_KINDS}")
+
+
+def build_trainer(kind: str):
+    from trlx_tpu.data.configs import TRLConfig
+
+    config = TRLConfig.from_dict(tiny_config_dict(kind))
+    if kind in ("ppo",):
+        from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+        return PPOTrainer(config)
+    if kind == "grpo":
+        from trlx_tpu.trainer.grpo_trainer import GRPOTrainer
+
+        return GRPOTrainer(config)
+    if kind == "ilql":
+        from trlx_tpu.trainer.ilql_trainer import ILQLTrainer
+
+        return ILQLTrainer(config)
+    from trlx_tpu.trainer.seq2seq_ppo_trainer import Seq2SeqPPOTrainer
+
+    return Seq2SeqPPOTrainer(config)
+
+
+@dataclass
+class TracedProgram:
+    subject: str  # e.g. "ppo.train_step"
+    closed_jaxpr: Any
+    mesh_axes: Set[str]
+    # flat state-leaf count the step must donate; None = no donation rule
+    n_donated_state_leaves: Optional[int] = None
+
+
+def _sds(tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+    )
+
+
+def _ppo_minibatch_sds(trainer):
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.data.ppo_types import PPORolloutBatch
+
+    B = trainer.config.train.batch_size
+    Q = trainer.query_length
+    R = trainer.gen_config.max_new_tokens
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)
+    return PPORolloutBatch(
+        query_tokens=i32(B, Q),
+        query_mask=i32(B, Q),
+        response_tokens=i32(B, R),
+        response_mask=i32(B, R),
+        logprobs=f32(B, R),
+        values=f32(B, R),
+        rewards=f32(B, R),
+    )
+
+
+def _ilql_minibatch_sds(trainer):
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.data.ilql_types import ILQLBatch
+
+    B = trainer.config.train.batch_size
+    T = trainer.config.train.seq_length
+    A = trainer.gen_config.max_new_tokens
+    S = A + 1
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)
+    return ILQLBatch(
+        input_ids=i32(B, T),
+        attention_mask=i32(B, T),
+        rewards=f32(B, A),
+        states_ixs=i32(B, S),
+        actions_ixs=i32(B, A),
+        dones=i32(B, S),
+        actions_mask=i32(B, A),
+    )
+
+
+def trace_trainer(kind: str) -> List[TracedProgram]:
+    """Build one tiny trainer and abstractly trace its jitted programs."""
+    import jax
+    import jax.numpy as jnp
+
+    trainer = build_trainer(kind)
+    axes = set(trainer.mesh.axis_names)
+    state_sds = _sds(trainer.state)
+    n_state = len(jax.tree_util.tree_leaves(state_sds))
+    if kind == "ilql":
+        mb = _ilql_minibatch_sds(trainer)
+    else:
+        mb = _ppo_minibatch_sds(trainer)
+
+    programs = [
+        TracedProgram(
+            subject=f"{kind}.train_step",
+            closed_jaxpr=jax.make_jaxpr(trainer._train_step_jit)(
+                state_sds, mb
+            ),
+            mesh_axes=axes,
+            n_donated_state_leaves=n_state,
+        )
+    ]
+
+    B = trainer.config.train.batch_size
+    Q = trainer.query_length
+    prompt = jax.ShapeDtypeStruct((B, Q), jnp.int32)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    if kind == "ilql":
+        bundle = {
+            "params": _sds(trainer.state.params),
+            "target": _sds(trainer.state.target_q_params),
+        }
+        sample_jaxpr = jax.make_jaxpr(trainer._sample_jit)(
+            bundle, prompt, prompt, key
+        )
+    else:
+        sample_jaxpr = jax.make_jaxpr(trainer._sample_jit)(
+            _sds(trainer.state.params), prompt, prompt, key
+        )
+    programs.append(
+        TracedProgram(
+            subject=f"{kind}.rollout",
+            closed_jaxpr=sample_jaxpr,
+            mesh_axes=axes,
+        )
+    )
+
+    if kind != "ilql":
+        # the fused buffer pass (scan over stacked minibatches) is the
+        # production train path — audit it too, with its own donation
+        stacked = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct((2,) + x.shape, x.dtype), mb
+        )
+        programs.append(
+            TracedProgram(
+                subject=f"{kind}.train_phase",
+                closed_jaxpr=jax.make_jaxpr(trainer._train_phase_jit)(
+                    state_sds, stacked
+                ),
+                mesh_axes=axes,
+                n_donated_state_leaves=n_state,
+            )
+        )
+    return programs
+
+
+def trace_all(kinds: Optional[Sequence[str]] = None) -> Iterator[TracedProgram]:
+    for kind in kinds or TRAINER_KINDS:
+        yield from trace_trainer(kind)

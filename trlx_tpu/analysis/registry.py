@@ -1,0 +1,149 @@
+"""Rule registry for the static-analysis pass.
+
+Every enforceable invariant is a registered :class:`Rule` with a stable id
+(the id is what ``# tpu-lint: disable=<id>`` names). Engines look their
+rules up here so the CLI can list, select, and document them uniformly;
+adding a rule means registering it and implementing its check in the
+owning engine (see docs/static_analysis.md, "Adding a rule").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+from trlx_tpu.analysis.findings import SEVERITY_ERROR, SEVERITY_WARNING
+
+ENGINE_JAXPR = "jaxpr"
+ENGINE_AST = "ast"
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    engine: str
+    description: str
+    severity: str = SEVERITY_ERROR
+    rationale: str = ""
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule: Rule) -> Rule:
+    if rule.id in _RULES:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _RULES[rule.id] = rule
+    return rule
+
+
+def get_rule(rule_id: str) -> Rule:
+    if rule_id not in _RULES:
+        raise KeyError(
+            f"unknown rule {rule_id!r}; registered: {sorted(_RULES)}"
+        )
+    return _RULES[rule_id]
+
+
+def all_rules(engine: str = "") -> List[Rule]:
+    rules = sorted(_RULES.values(), key=lambda r: (r.engine, r.id))
+    if engine:
+        rules = [r for r in rules if r.engine == engine]
+    return rules
+
+
+# --------------------------- jaxpr-audit rules --------------------------- #
+
+register_rule(Rule(
+    "fp64",
+    ENGINE_JAXPR,
+    "no float64 value anywhere in a traced program",
+    SEVERITY_ERROR,
+    "TPUs have no f64 units; an f64 leaf silently doubles memory and "
+    "falls back to slow emulation (the reference's torch code never "
+    "promotes, so any f64 here is an accident).",
+))
+register_rule(Rule(
+    "collective-axis",
+    ENGINE_JAXPR,
+    "every collective (psum/all_gather/ppermute/reduce_scatter/...) names "
+    "an axis of the trainer mesh",
+    SEVERITY_ERROR,
+    "A collective over an unknown axis either fails at compile on the "
+    "real slice topology or — worse — silently reduces over nothing.",
+))
+register_rule(Rule(
+    "donation",
+    ENGINE_JAXPR,
+    "train steps donate their input state buffers",
+    SEVERITY_ERROR,
+    "Without donation the optimizer state + params are double-buffered "
+    "through every update — the difference between fitting and OOM at "
+    "the 20B stretch shapes.",
+))
+register_rule(Rule(
+    "precision-leak",
+    ENGINE_JAXPR,
+    "no unexpected bf16->f32 convert of an activation-rank tensor inside "
+    "the compute-dtype forward (loss/optimizer reductions are allow-listed)",
+    SEVERITY_WARNING,
+    "A stray f32 upcast of a [B, T, D] tensor doubles that tensor's HBM "
+    "traffic and defeats the bf16 compute contract (PAPER.md: policy "
+    "loaded in bfloat16).",
+))
+register_rule(Rule(
+    "partition-spec",
+    ENGINE_JAXPR,
+    "every PartitionSpec produced by a family's partition rules is valid "
+    "on the mesh (axis exists, dim divisible)",
+    SEVERITY_ERROR,
+    "An invalid spec either crashes at jit time on the real topology or "
+    "silently replicates a tensor that was meant to shard.",
+))
+
+# ---------------------------- AST-lint rules ----------------------------- #
+
+register_rule(Rule(
+    "host-item",
+    ENGINE_AST,
+    "no .item() inside jit-decorated/traced functions",
+    SEVERITY_ERROR,
+    ".item() blocks on a device->host transfer; inside traced code it "
+    "either fails to trace or forces a sync per call (~100ms on a "
+    "tunneled chip).",
+))
+register_rule(Rule(
+    "host-scalar-cast",
+    ENGINE_AST,
+    "no float()/int() of a non-literal inside traced functions",
+    SEVERITY_ERROR,
+    "float(x) on a tracer is a ConcretizationTypeError at best and a "
+    "hidden host sync at worst; use x.astype(...) / jnp casts.",
+))
+register_rule(Rule(
+    "host-transfer",
+    ENGINE_AST,
+    "no jax.device_get / np.asarray / np.array inside traced functions",
+    SEVERITY_ERROR,
+    "Explicit host transfers inside traced code serialize the step "
+    "pipeline (OPPO in PAPERS.md: overlap wins evaporate under hidden "
+    "host syncs).",
+))
+register_rule(Rule(
+    "py-random",
+    ENGINE_AST,
+    "no Python random module inside traced functions",
+    SEVERITY_ERROR,
+    "Host RNG inside traced code bakes one sample into the compiled "
+    "program — every execution replays the same 'random' number; use "
+    "jax.random with explicit keys.",
+))
+register_rule(Rule(
+    "np-in-ops",
+    ENGINE_AST,
+    "ops/ kernels use jnp, not np, inside any function",
+    SEVERITY_ERROR,
+    "ops/ modules are kernel code whose functions run under trace; "
+    "np.* on a tracer escapes to host or fails. Module-level np "
+    "constants are fine.",
+))

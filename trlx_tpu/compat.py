@@ -1,0 +1,45 @@
+"""Version-compat shims for JAX APIs that moved or renamed.
+
+The framework targets current JAX, but must also run on the 0.4.x line
+(the CI/test image): ``shard_map`` graduated from
+``jax.experimental.shard_map`` to ``jax.shard_map``, and its replication
+check kwarg renamed ``check_rep`` -> ``check_vma``. Import ``shard_map``
+from here instead of from jax directly; the shim accepts the modern
+``check_vma`` spelling and translates for older jaxlibs.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# Newer jax replication checking (check_vma) infers varying-axes through
+# psum; 0.4.x's check_rep is stricter and rejects some valid out_specs —
+# call sites may key the check on this flag.
+HAS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+_HAS_CHECK_VMA = HAS_CHECK_VMA
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the modern keyword surface on any jax."""
+    kwargs = {}
+    if check_vma is not None:
+        kwargs["check_vma" if _HAS_CHECK_VMA else "check_rep"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (modern name) / ``TPUCompilerParams``
+    (jax 0.4.x) — same fields, renamed class."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return cls(**kwargs)

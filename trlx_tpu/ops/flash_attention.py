@@ -44,6 +44,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from trlx_tpu.compat import pallas_tpu_compiler_params
+
 from trlx_tpu.ops.attention import NEG_INF
 
 BLOCK_Q = 512  # best on v5e across 1k-4k sequences (see tests/test_flash_attention.py)
@@ -193,7 +195,7 @@ def _fwd(q, k, v, bias, *, scale, block_q, block_k, causal, interpret):
             pltpu.VMEM((block_q, LANES), jnp.float32),  # running sum
             pltpu.VMEM((block_q, D), jnp.float32),      # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -359,7 +361,7 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, block_q, block_k, causal,
         out_specs=q_tile_qk,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -401,7 +403,7 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, block_q, block_k, causal,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

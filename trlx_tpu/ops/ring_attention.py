@@ -303,7 +303,7 @@ def ring_attention_sharded(
     residuals stay O(Tq·D) at any global length. ``impl="naive"`` keeps the
     autodiff path (stores each rotation's score panel; useful as a
     reference)."""
-    from jax import shard_map
+    from trlx_tpu.compat import shard_map
 
     qkv_spec = P(batch_axes, axis_name, None, None)
     mask_spec = P(batch_axes, axis_name)

@@ -17,9 +17,11 @@ from trlx_tpu.parallel.mesh import (
     replicated,
 )
 from trlx_tpu.parallel.partition import (
+    PartitionRuleError,
     make_partition_specs,
     make_shardings,
     shard_params,
+    validate_rules,
 )
 from trlx_tpu.parallel.collectives import (
     RunningMoments,
@@ -39,9 +41,11 @@ __all__ = [
     "batch_sharding",
     "replicated",
     "local_batch_size",
+    "PartitionRuleError",
     "make_partition_specs",
     "make_shardings",
     "shard_params",
+    "validate_rules",
     "RunningMoments",
     "whiten",
     "masked_mean",

@@ -25,6 +25,17 @@ from trlx_tpu.parallel.mesh import AXIS_FSDP, AXIS_TP
 Rules = Sequence[Tuple[str, P]]
 
 
+class PartitionRuleError(ValueError):
+    """A partition rule produced an invalid placement for a param.
+
+    Raised at spec-construction time (i.e. when a family's rules are first
+    applied to a param tree) instead of silently replicating the tensor:
+    an axis name the mesh doesn't have, or a sharded dim the axis size
+    doesn't divide, is a configuration bug — on the real slice topology it
+    would either crash at jit time or quietly drop the intended sharding.
+    """
+
+
 def _path_str(path) -> str:
     parts = []
     for p in path:
@@ -57,6 +68,7 @@ def make_partition_specs(
     mesh: Mesh,
     rules: Optional[Rules] = None,
     min_shard_size: int = 2**14,
+    validate: bool = True,
 ) -> Any:
     """Produce a PartitionSpec pytree matching ``params``.
 
@@ -64,10 +76,20 @@ def make_partition_specs(
     contributes its tp placement; the fsdp axis is then layered onto the
     largest still-unsharded divisible dim (ZeRO-equivalent). Params smaller
     than ``min_shard_size`` elements stay replicated (biases, layernorms).
+
+    With ``validate`` (the default), a matching rule that names a mesh
+    axis the mesh doesn't have, or targets a dim the axis size doesn't
+    divide, raises :class:`PartitionRuleError` naming the offending param
+    path — instead of silently leaving the tensor replicated. Two
+    placements still degrade silently by design: an axis of size 1
+    (a tp rule on a tp=1 mesh is a no-op, not a bug) and a spec entry
+    beyond the leaf's rank (optimizer-state trees contain rank-0
+    placeholder leaves — ``optax.MaskedNode`` — on rule-matching paths).
     """
     rules = list(rules or [])
     fsdp = mesh.shape[AXIS_FSDP]
     tp = mesh.shape[AXIS_TP]
+    mesh_sizes = dict(mesh.shape)
 
     def spec_for(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()) or ())
@@ -77,11 +99,27 @@ def make_partition_specs(
             if re.search(pattern, name):
                 for i, ax in enumerate(pspec):
                     if ax is not None and i < len(shape):
-                        # Apply the rule's axis (tp, ep, ...) only if that
-                        # axis exists with size > 1 and divides the dim.
-                        n_ax = dict(mesh.shape).get(ax, 1)
-                        if n_ax > 1 and shape[i] % n_ax == 0:
-                            base[i] = ax
+                        if validate and ax not in mesh_sizes:
+                            raise PartitionRuleError(
+                                f"partition rule {pattern!r} names mesh "
+                                f"axis {ax!r} for param {name!r}, but the "
+                                f"mesh axes are {sorted(mesh_sizes)}"
+                            )
+                        # Apply the rule's axis (tp, ep, ...) only when the
+                        # axis is active (size > 1); axis size 1 is a no-op.
+                        n_ax = mesh_sizes.get(ax, 1)
+                        if n_ax > 1:
+                            if shape[i] % n_ax != 0:
+                                if validate:
+                                    raise PartitionRuleError(
+                                        f"partition rule {pattern!r} shards "
+                                        f"dim {i} of param {name!r} (shape "
+                                        f"{shape}) over axis {ax!r} of size "
+                                        f"{n_ax}, which does not divide "
+                                        f"{shape[i]}"
+                                    )
+                            else:
+                                base[i] = ax
                 break
         size = 1
         for s in shape:
@@ -97,6 +135,17 @@ def make_partition_specs(
         return P(*base)
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def validate_rules(params: Any, mesh: Mesh, rules: Optional[Rules]) -> None:
+    """Raise :class:`PartitionRuleError` if any rule produces an invalid
+    placement for ``params`` on ``mesh`` (see :func:`make_partition_specs`).
+
+    ``params`` may be a tree of arrays or of ``ShapeDtypeStruct``s — only
+    shapes are read, so families can validate at registration/startup time
+    against ``jax.eval_shape`` output without materializing weights.
+    """
+    make_partition_specs(params, mesh, rules, validate=True)
 
 
 def make_shardings(

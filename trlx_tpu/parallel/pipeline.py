@@ -240,7 +240,8 @@ def pipeline_apply_cached(
             raise ValueError(
                 f"cache layer dim {leaf.shape[0]} must divide pp={S}"
             )
-    n_batch_shards = int(np.prod([mesh.shape[a] for a in batch_axes]))
+    # mesh.shape is host metadata, not a tracer; the int() is trace-static
+    n_batch_shards = int(np.prod([mesh.shape[a] for a in batch_axes]))  # tpu-lint: disable=host-scalar-cast
     B_local = x.shape[0] // n_batch_shards
     if x.shape[0] % n_batch_shards or B_local % M:
         raise ValueError(
@@ -368,7 +369,7 @@ def pipeline_apply_cached(
         caps = jax.lax.psum(caps, axis_name)
         return outs.reshape(x.shape), cache, caps.reshape(x.shape)
 
-    from jax import shard_map
+    from trlx_tpu.compat import shard_map
 
     # Stage params enter shard_map sharded over pp ONLY: each device holds
     # its stage's L/S layers *fully materialized* for the loop's duration —
@@ -608,7 +609,7 @@ def pipeline_apply_remat(
             )
             return dparams, dxs.reshape(g.shape), daux
 
-        from jax import shard_map
+        from trlx_tpu.compat import HAS_CHECK_VMA, shard_map
 
         param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), params)
         x_spec = P(batch_axes)
@@ -628,6 +629,10 @@ def pipeline_apply_remat(
                 x_spec,
                 jax.tree_util.tree_map(lambda _: P(batch_axes), aux_f_outer),
             ),
+            # dx/daux are psum'd inside local_bwd; newer jax's vma pass
+            # infers that replication, 0.4.x's check_rep cannot and rejects
+            # the out_specs — keep the check only where it can succeed
+            check_vma=None if HAS_CHECK_VMA else False,
         )(params, saves, a, g)
         return (
             _insert_float0(dparams, params),

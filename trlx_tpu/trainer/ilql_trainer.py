@@ -566,8 +566,10 @@ class ILQLTrainer(BaseRLTrainer):
                 self._rollout_bundle_cache = None
                 self.state, stacked = self._train_chunk_jit(self.state, mbs)
                 chunk_time = clock.tick(train.batch_size) / 1000.0
-                # one transfer event for the whole stacked stats tree
-                rows = jax.device_get(stacked)
+                # one transfer event for the whole stacked stats tree AND
+                # the step counter — save() reuses the fetched step instead
+                # of paying its own device_get round-trip
+                rows, host_step = jax.device_get((stacked, self.state.step))
                 self.check_anomalies(rows, iter_count)
                 for j in range(k):
                     iter_count += 1
@@ -582,9 +584,9 @@ class ILQLTrainer(BaseRLTrainer):
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
                 if iv["do_save"] and iter_count < total_steps:
-                    self.save()
+                    self.save(step=int(host_step))
                 if iter_count >= total_steps:
-                    self.save()
+                    self.save(step=int(host_step))
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
@@ -593,13 +595,19 @@ class ILQLTrainer(BaseRLTrainer):
         self._final_stats = final_stats
         return final_stats
 
-    def save(self, directory: Optional[str] = None) -> None:
+    def save(
+        self, directory: Optional[str] = None, step: Optional[int] = None
+    ) -> None:
+        """``step`` lets the train loop reuse its already-fetched counter
+        (batched with the stats transfer) instead of a second round-trip."""
+        if step is None:
+            step = int(jax.device_get(self.state.step))
         save_checkpoint(
             directory or self.config.train.checkpoint_dir,
             self.state,
             metadata={},
             async_save=self.config.train.async_checkpoint,
-            step=int(jax.device_get(self.state.step)),
+            step=step,
         )
 
     def load(self, directory: str) -> None:

@@ -1074,6 +1074,9 @@ class PPOTrainer(BaseRLTrainer):
                         logger.log(step_stats, step=iter_count)
                         final_stats = dict(step_stats)
                 iv = self.intervals(iter_count)
+                if iv["do_save"] and iter_count >= total_steps:
+                    # the end-of-run branch below saves this same step
+                    iv["do_save"] = False
                 if iv["do_eval"]:
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)
@@ -1117,21 +1120,27 @@ class PPOTrainer(BaseRLTrainer):
                     self._profiling = False
 
                 iv = self.intervals(iter_count)
-                if iv["do_log"]:
+                at_end = iter_count >= total_steps
+                if iv["do_log"] or iv["do_save"] or at_end:
+                    # ONE stats fetch per step, shared by every host
+                    # consumer (logger, anomaly check before save) — the
+                    # log and save branches each paying their own
+                    # device_get doubled/tripled the host round-trips
                     step_stats = jax.device_get(step_stats)
+                    # never log or persist a NaN state
                     self.check_anomalies(step_stats, iter_count)
+                if iv["do_log"]:
                     logger.log(step_stats, step=iter_count)
                     final_stats = {k: float(v) for k, v in step_stats.items()}
                 if iv["do_eval"]:
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)
                     final_stats.update(eval_stats)
-                if iv["do_save"]:
-                    # never persist a NaN state between log points
-                    self.check_anomalies(jax.device_get(step_stats), iter_count)
+                if iv["do_save"] and not at_end:
+                    # at_end saves below — don't serialize the same step's
+                    # full sharded state twice when the intervals coincide
                     self.save()
-                if iter_count >= total_steps:
-                    self.check_anomalies(jax.device_get(step_stats), iter_count)
+                if at_end:
                     self.save()
                     eval_stats = self.evaluate()
                     logger.log(eval_stats, step=iter_count)

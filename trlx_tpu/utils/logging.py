@@ -66,13 +66,21 @@ class Logger:
     def log(self, stats: Dict[str, Any], step: Optional[int] = None) -> None:
         import jax
 
-        # pull any device scalars in ONE transfer event — per-value float()
-        # conversions each cost a full round-trip on a tunneled chip
+        # pull ALL device values in ONE transfer event — per-key float()
+        # conversions each cost a full round-trip on a tunneled chip.
+        # Flattening the whole stats pytree (not just top-level entries)
+        # catches device scalars nested under sub-dicts/lists too.
         if not self.is_main:
             return
-        device_vals = {k: v for k, v in stats.items() if isinstance(v, jax.Array)}
-        if device_vals:
-            stats = {**stats, **jax.device_get(device_vals)}
+        leaves, treedef = jax.tree_util.tree_flatten(stats)
+        device_ix = [
+            i for i, leaf in enumerate(leaves) if isinstance(leaf, jax.Array)
+        ]
+        if device_ix:
+            fetched = jax.device_get([leaves[i] for i in device_ix])
+            for i, v in zip(device_ix, fetched):
+                leaves[i] = v
+            stats = jax.tree_util.tree_unflatten(treedef, leaves)
         scalars = filter_non_scalars(stats)
         record = {"step": step, "time": round(time.time() - self.start, 2), **scalars}
         if self._pbar is not None:
