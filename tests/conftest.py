@@ -69,3 +69,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------- #
+# Suite-order isolation: reset module-global parallel context per test.
+#
+# gpt2_moe's ep mesh is process state installed by MoE trainers at
+# construction and read at *trace* time; without a reset, a jit traced in
+# a later test (e.g. the hydra/moe-parallel golden tests) can silently
+# pick up a stale mesh from whichever MoE e2e ran before it — the classic
+# "fails in full-suite order, passes in isolation" leak (ROADMAP Open
+# items). Function-scoped: trainers trace their programs inside the test
+# that builds them, so clearing *after* each test never breaks a live
+# trainer, only cross-test leakage.
+# ---------------------------------------------------------------------- #
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_parallel_context():
+    yield
+    import sys as _sys
+
+    moe_mod = _sys.modules.get("trlx_tpu.models.gpt2_moe")
+    if moe_mod is not None:  # only if the test actually imported it
+        moe_mod.reset()

@@ -123,6 +123,7 @@ def test_ul2_reward_helpers():
     assert scores[0] > scores[1]
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_seq2seq_bf16_param_storage_trains():
     """The fork loads the whole T5 in bfloat16 (`ppo_models.py:615`);
     param_dtype=bfloat16 must train without dtype errors and keep params

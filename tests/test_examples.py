@@ -76,6 +76,7 @@ def test_char_tokenizer_roundtrip():
     assert tok.decode(tok.encode(text)) == text
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_sentiments_standin_tiers_run():
     """Both sentiment examples' zero-egress stand-in tiers (pretrained local
     policy + classifier stand-in reward/metric) run end-to-end on the CPU

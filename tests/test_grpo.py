@@ -161,6 +161,7 @@ def test_group_scale_requires_group_size():
         get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_seq2seq_grpo_learns():
     """GRPO over the T5 seq2seq path: grouped decoder rollouts per encoder
     prompt, copy-task reward rises."""
@@ -238,6 +239,7 @@ def test_grpo_composes_with_pipeline_parallelism():
     assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_seq2seq_grpo_composes_with_pp():
     """Round-4 composition: Seq2SeqGRPOTrainer on a pp mesh runs grouped
     rollouts through the stage-resident T5 sampler and its update through

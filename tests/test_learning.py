@@ -99,6 +99,7 @@ def learned():
     return trainer, phase_means
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_reward_improves(learned):
     _, phase_means = learned
     # random policy emits the target ~1/14 of steps (~0.07); a learning
@@ -106,6 +107,7 @@ def test_reward_improves(learned):
     assert_reward_improved(phase_means)
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_policy_not_collapsed_to_eos(learned):
     trainer, _ = learned
     full = trainer.buffer.full
@@ -183,6 +185,7 @@ def ilql_learned():
     return trainer, target
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_ilql_generation_prefers_rewarded_token(ilql_learned):
     trainer, target = ilql_learned
     trainer.evaluate()
@@ -266,6 +269,7 @@ def seq2seq_learned():
     return phase_means
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_seq2seq_reward_improves(seq2seq_learned):
     assert_reward_improved(seq2seq_learned)
 

@@ -55,6 +55,7 @@ def _run_ppo(model_type, model_arch):
     assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_ppo_gptj_family():
     _run_ppo(
         "gptj",
@@ -65,6 +66,7 @@ def test_ppo_gptj_family():
     )
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_ppo_gpt_neo_family():
     _run_ppo(
         "gpt_neo",

@@ -121,6 +121,7 @@ def test_per_chip_bytes_fit_v4_budget(plan):
     )
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_20b_longcontext_budget_with_pp_remat_and_bf16_moments():
     """Round-5 (VERDICT r4 #4): compose what round 4 bought — `pp_remat`
     + bf16 moments — at the 20B scale and derive what actually fits.

@@ -127,6 +127,7 @@ def test_bf16_moments_match_f32_trajectory():
     assert lossbf < 2.0 * loss32 + 1e-3, (lossbf, loss32)
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_ppo_learns_with_bf16_moments():
     """End-to-end learning parity (VERDICT r3 #8): the fast synthetic PPO
     task from test_learning.py still learns with bf16 moments."""

@@ -60,6 +60,7 @@ def _assert_reward_rose(means):
     assert late > early + 0.2, (early, late, means)
 
 
+@pytest.mark.slow  # checkpoint-convert + full PPO compile per family: nightly tier
 @pytest.mark.parametrize("family", ["gpt2", "t5"])
 def test_pretrained_checkpoint_to_ppo(tmp_path, family):
     import jax.numpy as jnp

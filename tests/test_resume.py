@@ -77,6 +77,7 @@ def test_resume_continues_from_saved_step(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_resume_with_masked_and_bf16_moment_opt_state(tmp_path):
     """Round-4 optimizer-state shapes survive the checkpoint round trip:
     frozen bottom layers (optax.masked — frozen leaves carry NO moment
@@ -158,6 +159,7 @@ def test_ilql_api_default_eval_prompts_from_token_samples(tmp_path):
     assert int(trainer.state.step) == 2
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_fresh_run_ignores_stale_checkpoint(tmp_path):
     t1 = _train(_config(tmp_path, total_steps=2))
     assert int(t1.state.step) == 2

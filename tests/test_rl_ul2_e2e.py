@@ -24,11 +24,13 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DRIVER = os.path.join(REPO, "tests", "_rl_ul2_driver.py")
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_rl_ul2_standin_tier_learns_under_dp_pp():
     last = None
     for _attempt in range(2):

@@ -84,7 +84,11 @@ def _prompts():
     "model_type,arch",
     [
         ("gpt2", None),
-        ("gpt2_moe", {"n_experts": 2, "moe_every": 2, "capacity_factor": 4.0}),
+        pytest.param(
+            "gpt2_moe",
+            {"n_experts": 2, "moe_every": 2, "capacity_factor": 4.0},
+            marks=pytest.mark.slow,  # moe variant: nightly tier
+        ),
     ],
 )
 def test_cast_sampler_is_bit_identical(model_type, arch):

@@ -49,6 +49,7 @@ def _make_sampler(config, model, Q, R, do_sample):
     )
 
 
+@pytest.mark.slow  # compile-heavy e2e: nightly tier (tier-1 870 s budget)
 def test_greedy_matches_naive_loop(tiny_policy):
     import jax
     import jax.numpy as jnp

@@ -1,14 +1,24 @@
-"""Static analysis for the TPU port: jaxpr audit + AST lint.
+"""Static analysis for the TPU port: jaxpr audit, AST lint, NaN-source
+dataflow, collective-sequence divergence, and an eqn-level sanitizer.
 
-Two engines enforce the invariants the reference kept by convention
+The engines enforce the invariants the reference kept by convention
 (bf16 compute / f32 optimizer, frozen KL reference, declared-collective
-parallelism) and the host-sync discipline OPPO/HEPPO-GAE (PAPERS.md) show
-PPO throughput hinges on:
+parallelism), the host-sync discipline OPPO/HEPPO-GAE (PAPERS.md) show
+PPO throughput hinges on, and — since PR 2 — the numerics-and-SPMD
+safety properties the fsdp/tp NaN divergence exposed:
 
 - :mod:`trlx_tpu.analysis.jaxpr_audit` — traces the trainers' jitted
   step/rollout programs abstractly on a CPU mesh and walks the jaxprs.
 - :mod:`trlx_tpu.analysis.ast_lint` — rule-based source checker for
-  host-sync / tracer-safety hazards in traced Python code.
+  host-sync / tracer-safety hazards in traced Python code, plus the
+  host-branch SPMD-desync rule for host-loop code.
+- :mod:`trlx_tpu.analysis.nan_flow` — guard-dominance dataflow flagging
+  ops that can mint NaN/Inf from unguarded operands.
+- :mod:`trlx_tpu.analysis.collective_trace` — collective schedules must
+  be identical across the dp/fsdp/tp mesh matrix up to axis renaming.
+- :mod:`trlx_tpu.analysis.sanitizer` — ``--sanitize <trainer>`` replays
+  a captured step jaxpr eqn-by-eqn on concrete values and reports the
+  first non-finite equation with source provenance.
 
 Run ``python -m trlx_tpu.analysis --help`` or see docs/static_analysis.md.
 """
@@ -39,10 +49,12 @@ def run(
 ) -> Report:
     """Run the selected engine(s); returns a merged :class:`Report`.
 
-    :param engine: ``all`` | ``jaxpr`` | ``ast``.
+    :param engine: ``all`` | ``jaxpr`` | ``ast`` | ``nanflow`` |
+        ``collective``.
     :param paths: files/dirs for the AST lint (default: the trlx_tpu
         package directory).
-    :param trainers: trainer kinds for the jaxpr audit (default: all four).
+    :param trainers: trainer kinds for the trainer-tracing engines
+        (default: all four).
     """
     import os
 
@@ -57,10 +69,30 @@ def run(
         report.extend(findings)
         report.covered += covered
         report.suppressed += suppressed
-    if engine in ("all", "jaxpr"):
-        from trlx_tpu.analysis.jaxpr_audit import audit_trainers
+    if engine in ("all", "jaxpr", "nanflow"):
+        # one trace of the trainer programs feeds both jaxpr-walking
+        # engines — trainer construction dominates the cost
+        from trlx_tpu.analysis import harness
 
-        sub = audit_trainers(trainers)
+        programs = list(harness.trace_all(trainers))
+        if engine in ("all", "jaxpr"):
+            from trlx_tpu.analysis.jaxpr_audit import audit_trainers
+
+            sub = audit_trainers(trainers, programs=programs)
+            report.extend(sub.findings)
+            report.covered += sub.covered
+            report.suppressed += sub.suppressed
+        if engine in ("all", "nanflow"):
+            from trlx_tpu.analysis.nan_flow import analyze_trainers
+
+            sub = analyze_trainers(trainers, programs=programs)
+            report.extend(sub.findings)
+            report.covered += sub.covered
+            report.suppressed += sub.suppressed
+    if engine in ("all", "collective"):
+        from trlx_tpu.analysis.collective_trace import check_all
+
+        sub = check_all(trainers)
         report.extend(sub.findings)
         report.covered += sub.covered
         report.suppressed += sub.suppressed

@@ -29,9 +29,29 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--engine",
-        choices=("all", "jaxpr", "ast"),
+        choices=("all", "jaxpr", "ast", "nanflow", "collective"),
         default="all",
         help="which engine(s) to run (default: all)",
+    )
+    parser.add_argument(
+        "--sanitize",
+        metavar="TRAINER",
+        default=None,
+        help="instead of the rule engines: replay TRAINER's train step "
+        "eqn-by-eqn on concrete values and report the first non-finite "
+        "equation (ppo|ilql|grpo|seq2seq)",
+    )
+    parser.add_argument(
+        "--mesh",
+        default=None,
+        help="mesh axis sizes for --sanitize, e.g. dp=2,fsdp=2,tp=2 "
+        "(default: the audit mesh)",
+    )
+    parser.add_argument(
+        "--plant-nan",
+        action="store_true",
+        help="poison one param leaf with NaN before --sanitize — "
+        "self-check that the replay detects and attributes it",
     )
     parser.add_argument(
         "--paths",
@@ -66,7 +86,24 @@ def main(argv=None) -> int:
                   f"{rule.description}")
         return 0
 
-    if args.engine in ("all", "jaxpr"):
+    if args.sanitize:
+        _force_cpu_platform()
+        from trlx_tpu.analysis.sanitizer import sanitize_trainer
+
+        mesh = None
+        if args.mesh:
+            mesh = {
+                k.strip(): int(v)
+                for k, v in (kv.split("=") for kv in args.mesh.split(","))
+            }
+        result = sanitize_trainer(
+            args.sanitize, mesh=mesh, plant=args.plant_nan
+        )
+        report = result.to_report()
+        print(report.to_json() if args.json else result.format_text())
+        return report.exit_code(strict=args.strict)
+
+    if args.engine in ("all", "jaxpr", "nanflow", "collective"):
         _force_cpu_platform()
 
     from trlx_tpu.analysis import run

@@ -19,6 +19,14 @@ Plus one scope rule: ``np-in-ops`` — inside ``trlx_tpu/ops/`` every
 function body must use ``jnp``, not ``np`` (ops/ is kernel code; its
 functions run under trace by contract even when this file cannot prove it).
 
+And one *host-side* SPMD rule: ``host-branch`` — in functions *outside*
+the traced region (the host training loop), an ``if``/``while`` test that
+reads a device-derived value (``float(x)``/``int(x)`` of a non-static
+expression, or a subscript of a ``*stats`` dict) can take different arms
+on different hosts of a multi-host slice; if any arm dispatches device
+work, the next collective hangs (LlamaRL: all workers must execute one
+schedule). Branch on config/step counters instead, or all-gather first.
+
 The traced-region computation is a static over/under-approximation: calls
 through containers, getattr strings, or cross-module helpers are not
 followed. False positives are silenced inline with
@@ -361,6 +369,86 @@ class _TracedBodyLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _is_stats_subscript(node: ast.AST) -> bool:
+    """``stats[...]`` / ``step_stats[...]`` / ``self.step_stats[...]``."""
+    if not isinstance(node, ast.Subscript):
+        return False
+    base = node.value
+    name = None
+    if isinstance(base, ast.Name):
+        name = base.id
+    elif isinstance(base, ast.Attribute):
+        name = base.attr
+    return bool(name) and (name == "stats" or name.endswith("_stats"))
+
+
+class _HostBranchLinter(ast.NodeVisitor):
+    """host-branch: device-derived values steering host control flow in
+    untraced (host-loop) functions."""
+
+    def __init__(self, path: str, subject: str, static_names: Set[str]) -> None:
+        self.path = path
+        self.subject = subject
+        self.static_names = static_names
+        self.findings: List[Finding] = []
+
+    def _add(self, node: ast.AST, message: str) -> None:
+        rule = get_rule("host-branch")
+        self.findings.append(
+            Finding(
+                rule=rule.id,
+                message=message,
+                severity=rule.severity,
+                file=self.path,
+                line=getattr(node, "lineno", None),
+                subject=self.subject,
+                engine="ast",
+            )
+        )
+
+    def _check_test(self, test: ast.AST) -> None:
+        for sub in ast.walk(test):
+            if _is_stats_subscript(sub):
+                self._add(
+                    sub,
+                    "host branch on a stats value: different hosts can "
+                    "fetch different values and take different arms, "
+                    "desynchronizing the collective schedule; branch on "
+                    "step counters/config, or all-gather the scalar first",
+                )
+                return
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id in ("float", "int")
+                and sub.args
+                and not isinstance(sub.args[0], ast.Constant)
+                and not _is_static_expr(sub.args[0], self.static_names)
+            ):
+                self._add(
+                    sub,
+                    f"host branch on {sub.func.id}() of a device-derived "
+                    "value: per-host results can differ and desynchronize "
+                    "hosts before the next collective",
+                )
+                return
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    def _skip_nested_def(self, node) -> None:
+        # nested defs lint under their own (traced/host) classification
+        return
+
+    visit_FunctionDef = _skip_nested_def
+    visit_AsyncFunctionDef = _skip_nested_def
+
+
 class _OpsNumpyLinter(ast.NodeVisitor):
     """np-in-ops: no `np.` inside any function body of an ops/ module."""
 
@@ -430,6 +518,16 @@ def lint_source(
             for stmt in node.body:
                 linter.visit(stmt)
             findings.extend(linter.findings)
+
+    # host-loop (untraced) functions: SPMD-desync branch rule
+    for name in sorted(set(index.defs) - traced):
+        for node in index.defs.get(name, ()):
+            host_linter = _HostBranchLinter(
+                path, f"{name}()", _collect_static_names(node)
+            )
+            for stmt in node.body:
+                host_linter.visit(stmt)
+            findings.extend(host_linter.findings)
 
     # lambdas passed directly to trace entries (no named def to index)
     class _LambdaArgs(ast.NodeVisitor):

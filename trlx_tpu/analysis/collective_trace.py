@@ -1,0 +1,168 @@
+"""Collective-sequence divergence: SPMD schedule equality across meshes.
+
+Engine 4 of ``trlx_tpu.analysis``. Distributed RLHF correctness hinges on
+every worker executing the *same* collective schedule (LlamaRL, PAPERS.md):
+a collective sequence that depends on mesh topology — an extra psum on the
+fsdp/tp mesh, a reordered all_gather — either deadlocks the slice or
+silently reduces mismatched programs. The check:
+
+1. for each trainer kind, trace the jitted train step on every mesh of
+   :data:`MESH_MATRIX` (the dp/fsdp/tp family the PR-1 harness covers —
+   topologies that must be *semantically interchangeable*; pp/sp/ep
+   meshes legitimately change the schedule and are excluded);
+2. extract the linearized sequence of explicitly-named collective eqns
+   (``psum``/``all_gather``/``reduce_scatter``/``ppermute``/... with
+   their axes) in program order, recursing through sub-jaxprs;
+3. canonicalize axis names by order of first appearance (``up to axis
+   renaming`` — dp on one mesh may be fsdp on another);
+4. flag any mesh whose canonical sequence differs from the first mesh's,
+   reporting the first diverging index.
+
+Only *explicit* collectives (shard_map kernels, ring/pipeline primitives)
+appear in pre-GSPMD jaxprs; GSPMD-inserted reductions are derived from
+shardings and cannot desynchronize by construction. An empty-vs-empty
+match is therefore the healthy result for purely-GSPMD trainers — the
+rule exists to keep it that way as hand-written kernels spread.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from trlx_tpu.analysis.findings import Finding
+from trlx_tpu.analysis.registry import get_rule
+
+# Mesh shapes expected to run IDENTICAL collective schedules (data/tensor
+# sharding variants of the same program). 8 virtual devices resolve the
+# -1 wildcard; all shapes divide the harness's tiny batch of 8.
+MESH_MATRIX: Sequence[Dict[str, int]] = (
+    {"dp": -1, "fsdp": 1, "tp": 1},
+    {"dp": -1, "fsdp": 2, "tp": 1},
+    {"dp": -1, "fsdp": 1, "tp": 2},
+    {"dp": 2, "fsdp": 2, "tp": 2},
+)
+
+# Sequence entry: (primitive name, axis names as written, static detail
+# that must also match — e.g. a ppermute's permutation).
+SeqEntry = Tuple[str, Tuple[str, ...], str]
+
+
+def _mesh_label(mesh: Dict[str, int]) -> str:
+    return (
+        "/".join(f"{k}={v}" for k, v in sorted(mesh.items()) if v != 1)
+        or "single-axis"
+    )
+
+
+def collective_sequence(closed_jaxpr) -> List[SeqEntry]:
+    """Linearized named-collective sequence of a (closed) jaxpr, in
+    program order, recursing into sub-jaxprs."""
+    from trlx_tpu.analysis.jaxpr_audit import (
+        COLLECTIVE_PRIMS,
+        _axis_names_of,
+        iter_eqns,
+    )
+
+    seq: List[SeqEntry] = []
+    for eqn in iter_eqns(closed_jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMS or name == "axis_index":
+            continue
+        axes = tuple(_axis_names_of(eqn))
+        detail = ""
+        if name == "ppermute":
+            detail = str(eqn.params.get("perm", ""))
+        elif name == "all_to_all":
+            detail = (
+                f"split={eqn.params.get('split_axis')},"
+                f"concat={eqn.params.get('concat_axis')}"
+            )
+        seq.append((name, axes, detail))
+    return seq
+
+
+def canonicalize(seq: Sequence[SeqEntry]) -> List[Tuple[str, Tuple[int, ...], str]]:
+    """Rename axes to their order of first appearance so sequences from
+    different meshes compare 'up to axis renaming'."""
+    names: Dict[str, int] = {}
+    out = []
+    for prim, axes, detail in seq:
+        canon = []
+        for a in axes:
+            if a not in names:
+                names[a] = len(names)
+            canon.append(names[a])
+        out.append((prim, tuple(canon), detail))
+    return out
+
+
+def check_sequences(
+    sequences: Dict[str, Sequence[SeqEntry]], subject: str
+) -> List[Finding]:
+    """Compare per-mesh collective sequences; findings name the first
+    diverging index against the reference (first) mesh."""
+    rule = get_rule("collective-divergence")
+    findings: List[Finding] = []
+    items = list(sequences.items())
+    if not items:
+        return findings
+    ref_label, ref_seq = items[0]
+    ref_canon = canonicalize(ref_seq)
+    for label, seq in items[1:]:
+        canon = canonicalize(seq)
+        if canon == ref_canon:
+            continue
+        # locate the first diverging position for the report
+        i = next(
+            (k for k, (a, b) in enumerate(zip(ref_canon, canon)) if a != b),
+            min(len(ref_canon), len(canon)),
+        )
+        ref_at = ref_seq[i] if i < len(ref_seq) else "<end>"
+        got_at = sequences[label][i] if i < len(seq) else "<end>"
+        findings.append(
+            Finding(
+                rule=rule.id,
+                message=(
+                    f"collective schedule diverges between meshes "
+                    f"{ref_label!r} ({len(ref_seq)} collectives) and "
+                    f"{label!r} ({len(seq)} collectives) at position {i}: "
+                    f"{ref_at} vs {got_at} — all workers must execute one "
+                    "schedule regardless of topology"
+                ),
+                severity=rule.severity,
+                subject=subject,
+                engine="collective",
+            )
+        )
+    return findings
+
+
+def check_trainer(
+    kind: str, meshes: Optional[Sequence[Dict[str, int]]] = None
+) -> Tuple[List[Finding], List[str]]:
+    """Trace one trainer's train step across the mesh matrix and check
+    schedule equality; returns (findings, covered subjects)."""
+    from trlx_tpu.analysis import harness
+
+    sequences: Dict[str, Sequence[SeqEntry]] = {}
+    covered: List[str] = []
+    for mesh in meshes or MESH_MATRIX:
+        label = _mesh_label(mesh)
+        closed = harness.trace_train_step(kind, mesh)
+        sequences[label] = collective_sequence(closed)
+        covered.append(f"collective:{kind}.train_step[{label}]")
+    return check_sequences(sequences, f"{kind}.train_step"), covered
+
+
+def check_all(kinds=None):
+    """Collective-divergence check over trainer kinds; returns a
+    :class:`~trlx_tpu.analysis.findings.Report`."""
+    from trlx_tpu.analysis import harness
+    from trlx_tpu.analysis.findings import Report
+
+    report = Report()
+    for kind in kinds or harness.TRAINER_KINDS:
+        findings, covered = check_trainer(kind)
+        report.extend(findings)
+        report.covered += covered
+    return report

@@ -146,10 +146,10 @@ def tiny_config_dict(kind: str, mesh: Optional[Dict[str, int]] = None) -> Dict:
     raise ValueError(f"unknown trainer kind {kind!r}; know {TRAINER_KINDS}")
 
 
-def build_trainer(kind: str):
+def build_trainer(kind: str, mesh: Optional[Dict[str, int]] = None):
     from trlx_tpu.data.configs import TRLConfig
 
-    config = TRLConfig.from_dict(tiny_config_dict(kind))
+    config = TRLConfig.from_dict(tiny_config_dict(kind, mesh))
     if kind in ("ppo",):
         from trlx_tpu.trainer.ppo_trainer import PPOTrainer
 
@@ -174,6 +174,23 @@ class TracedProgram:
     mesh_axes: Set[str]
     # flat state-leaf count the step must donate; None = no donation rule
     n_donated_state_leaves: Optional[int] = None
+    # flat keypath label per program input (make_jaxpr flattening order) —
+    # lets value-contract engines (nan_flow) seed facts like "masks are
+    # 0/1" and "adam nu is nonnegative" at the program boundary
+    input_paths: Optional[List[str]] = None
+
+
+def flat_input_paths(*trees, prefixes: Optional[Sequence[str]] = None) -> List[str]:
+    """Flat keypath labels for argument trees, in make_jaxpr's
+    flattening order."""
+    import jax
+
+    names: List[str] = []
+    for i, tree in enumerate(trees):
+        prefix = prefixes[i] if prefixes else f"arg{i}"
+        for path, _leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            names.append(prefix + jax.tree_util.keystr(path))
+    return names
 
 
 def _sds(tree):
@@ -229,6 +246,65 @@ def _ilql_minibatch_sds(trainer):
     )
 
 
+def trace_train_step(kind: str, mesh: Optional[Dict[str, int]] = None):
+    """Abstractly trace just one trainer's jitted train step on ``mesh``
+    (the collective-divergence engine traces the same step on several
+    meshes; the full program set would triple the tracing cost)."""
+    import jax
+
+    trainer = build_trainer(kind, mesh)
+    state_sds = _sds(trainer.state)
+    mb = _ilql_minibatch_sds(trainer) if kind == "ilql" else _ppo_minibatch_sds(trainer)
+    return jax.make_jaxpr(trainer._train_step_jit)(state_sds, mb)
+
+
+def concrete_minibatch(trainer, kind: str, seed: int = 0):
+    """A concrete, numerically-plausible rollout minibatch for the
+    sanitizer's eqn-level replay (abstract tracing can't evaluate
+    values): logprobs are small negatives, values/rewards small normals,
+    masks cover a realistic prefix of the response."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from trlx_tpu.data.ilql_types import ILQLBatch
+    from trlx_tpu.data.ppo_types import PPORolloutBatch
+
+    rng = np.random.default_rng(seed)
+    B = trainer.config.train.batch_size
+    vocab = 30
+    if kind == "ilql":
+        T = trainer.config.train.seq_length
+        A = trainer.gen_config.max_new_tokens
+        S = A + 1
+        return ILQLBatch(
+            input_ids=jnp.asarray(rng.integers(1, vocab, (B, T)), jnp.int32),
+            attention_mask=jnp.ones((B, T), jnp.int32),
+            rewards=jnp.asarray(rng.normal(0, 0.5, (B, A)), jnp.float32),
+            states_ixs=jnp.asarray(
+                np.tile(np.arange(S), (B, 1)), jnp.int32
+            ),
+            actions_ixs=jnp.asarray(
+                np.tile(np.arange(A), (B, 1)), jnp.int32
+            ),
+            dones=jnp.ones((B, S), jnp.int32),
+            actions_mask=jnp.ones((B, A), jnp.int32),
+        )
+    Q = trainer.query_length
+    R = trainer.gen_config.max_new_tokens
+    lengths = rng.integers(max(1, R - 2), R + 1, B)
+    response_mask = (np.arange(R)[None, :] < lengths[:, None]).astype(np.int32)
+    return PPORolloutBatch(
+        query_tokens=jnp.asarray(rng.integers(1, vocab, (B, Q)), jnp.int32),
+        query_mask=jnp.ones((B, Q), jnp.int32),
+        response_tokens=jnp.asarray(rng.integers(1, vocab, (B, R)), jnp.int32),
+        response_mask=jnp.asarray(response_mask),
+        logprobs=jnp.asarray(-np.abs(rng.normal(1.5, 0.7, (B, R))), jnp.float32),
+        values=jnp.asarray(rng.normal(0, 0.3, (B, R)), jnp.float32),
+        rewards=jnp.asarray(rng.normal(0, 0.5, (B, R)) * response_mask, jnp.float32),
+    )
+
+
 def trace_trainer(kind: str) -> List[TracedProgram]:
     """Build one tiny trainer and abstractly trace its jitted programs."""
     import jax
@@ -243,6 +319,7 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
     else:
         mb = _ppo_minibatch_sds(trainer)
 
+    step_paths = flat_input_paths(state_sds, mb, prefixes=("state", "batch"))
     programs = [
         TracedProgram(
             subject=f"{kind}.train_step",
@@ -251,6 +328,7 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
             ),
             mesh_axes=axes,
             n_donated_state_leaves=n_state,
+            input_paths=step_paths,
         )
     ]
 
@@ -270,11 +348,20 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
         sample_jaxpr = jax.make_jaxpr(trainer._sample_jit)(
             _sds(trainer.state.params), prompt, prompt, key
         )
+    rollout_args = (
+        (bundle, prompt, prompt, key)
+        if kind == "ilql"
+        else (_sds(trainer.state.params), prompt, prompt, key)
+    )
     programs.append(
         TracedProgram(
             subject=f"{kind}.rollout",
             closed_jaxpr=sample_jaxpr,
             mesh_axes=axes,
+            input_paths=flat_input_paths(
+                *rollout_args,
+                prefixes=("params", "prompt_ids", "prompt_mask", "key"),
+            ),
         )
     )
 
@@ -292,6 +379,9 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
                 ),
                 mesh_axes=axes,
                 n_donated_state_leaves=n_state,
+                input_paths=flat_input_paths(
+                    state_sds, stacked, prefixes=("state", "batch")
+                ),
             )
         )
     return programs

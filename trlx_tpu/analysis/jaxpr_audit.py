@@ -429,8 +429,11 @@ def audit_program(
     return unique
 
 
-def audit_trainers(kinds: Optional[Sequence[str]] = None):
-    """Trace all trainer programs via the harness and audit them.
+def audit_trainers(kinds: Optional[Sequence[str]] = None, programs=None):
+    """Trace all trainer programs via the harness and audit them
+    (``programs``: pre-traced :class:`~trlx_tpu.analysis.harness.
+    TracedProgram` list, so callers running several jaxpr engines trace
+    once).
 
     Returns a :class:`~trlx_tpu.analysis.findings.Report`.
     """
@@ -439,7 +442,7 @@ def audit_trainers(kinds: Optional[Sequence[str]] = None):
 
     report = Report()
     mesh_findings: List[Finding] = []
-    for traced in harness.trace_all(kinds):
+    for traced in programs if programs is not None else harness.trace_all(kinds):
         report.covered.append(traced.subject)
         mesh_findings += audit_program(
             traced.closed_jaxpr,

@@ -16,6 +16,9 @@ from trlx_tpu.analysis.findings import SEVERITY_ERROR, SEVERITY_WARNING
 
 ENGINE_JAXPR = "jaxpr"
 ENGINE_AST = "ast"
+ENGINE_NANFLOW = "nanflow"
+ENGINE_COLLECTIVE = "collective"
+ENGINE_SANITIZER = "sanitizer"
 
 
 @dataclass(frozen=True)
@@ -99,6 +102,78 @@ register_rule(Rule(
     SEVERITY_ERROR,
     "An invalid spec either crashes at jit time on the real topology or "
     "silently replicates a tensor that was meant to shard.",
+))
+
+# --------------------------- NaN-dataflow rules -------------------------- #
+
+register_rule(Rule(
+    "nan-unguarded",
+    ENGINE_NANFLOW,
+    "every op that can mint a NaN/Inf (div, log, rsqrt, sqrt, exp "
+    "overflow, fractional pow) has its operand dominated by a guard "
+    "(+eps, clip/maximum, where on the input)",
+    SEVERITY_ERROR,
+    "The fsdp/tp PPO divergence is exactly this class: one unguarded "
+    "op (unclipped exp(log_ratio), eps-free rsqrt) mints the first "
+    "NaN and the optimizer propagates it everywhere within a step.",
+))
+register_rule(Rule(
+    "where-grad-trap",
+    ENGINE_NANFLOW,
+    "no unguarded non-total op whose output is masked by where/select — "
+    "the backward pass evaluates it on masked lanes anyway",
+    SEVERITY_ERROR,
+    "grad(where(mask, f(x), 0)) evaluates f'(x) on every lane and "
+    "multiplies inf by the zero cotangent: 0*inf = NaN gradients while "
+    "the forward value looks fine. The guard must sit on f's input.",
+))
+register_rule(Rule(
+    "inf-mask-softmax",
+    ENGINE_NANFLOW,
+    "no softmax denominator built from a -inf-masked input without a "
+    "row-liveness guarantee",
+    SEVERITY_WARNING,
+    "where(mask, s, -inf) into softmax divides 0/0 on a fully-masked "
+    "row. Causal self-attention rows always see themselves; anything "
+    "else (padding-only rows, cross-attention) needs a re-select.",
+))
+
+# ------------------------ collective-sequence rules ----------------------- #
+
+register_rule(Rule(
+    "collective-divergence",
+    ENGINE_COLLECTIVE,
+    "a trainer's linearized collective sequence (psum/all_gather/"
+    "reduce_scatter/ppermute + axes) is identical across the mesh "
+    "matrix up to axis renaming",
+    SEVERITY_ERROR,
+    "Distributed RLHF correctness hinges on all workers executing the "
+    "same collective schedule (LlamaRL): a topology-dependent psum "
+    "order deadlocks or silently mismatches reductions on the slice.",
+))
+register_rule(Rule(
+    "host-branch",
+    ENGINE_AST,
+    "no host Python branch on device-derived values (float(x) of a "
+    "fetched stat, step_stats[...]) in multi-host trainer loop code",
+    SEVERITY_WARNING,
+    "A branch on a per-host value can take different arms on "
+    "different hosts; the next collective then hangs or reduces "
+    "mismatched programs. Branch on config/step counters, or "
+    "all-gather the scalar first.",
+))
+
+# ----------------------------- sanitizer rule ----------------------------- #
+
+register_rule(Rule(
+    "sanitizer-nonfinite",
+    ENGINE_SANITIZER,
+    "eqn-level replay of a captured step jaxpr finds no equation whose "
+    "output is the program's first NaN/Inf",
+    SEVERITY_ERROR,
+    "Replaying the step eqn-by-eqn turns 'PPO diverges on fsdp/tp' "
+    "into 'this equation, this source line, this param path minted "
+    "the first NaN' — a one-command localization instead of printf.",
 ))
 
 # ---------------------------- AST-lint rules ----------------------------- #

@@ -57,7 +57,34 @@ class PPORolloutBatch:
 
 
 def concat_rollouts(batches) -> PPORolloutBatch:
-    """Concatenate rollout batches along the batch axis."""
-    return jax.tree_util.tree_map(
-        lambda *xs: jnp.concatenate(xs, axis=0), *batches
-    )
+    """Concatenate rollout batches along the batch axis.
+
+    Implemented as ``dynamic_update_slice`` writes into a fresh buffer,
+    NOT ``jnp.concatenate``: on any mesh with a size>1 axis absent from
+    the chunks' batch sharding (tp/sp/pp/ep), XLA's SPMD partitioner
+    mis-lowers concatenate of the committed-sharded chunk arrays into a
+    *sum over the replica axis* — token ids double (11+11=22), masks
+    become 2, and the out-of-vocab embed lookups then fill NaN (jax
+    0.4.x; eager and jitted concat both reproduce). This was the root
+    cause of the fsdp/tp PPO "NaN within a few steps" divergence: the
+    first buffer concat corrupted every minibatch. dynamic_update_slice
+    resolves the same input shardings correctly; the sanitizer replay
+    (``python -m trlx_tpu.analysis --sanitize``) localizes regressions
+    of this class to the first NaN-minting equation.
+    """
+    batches = list(batches)
+    if len(batches) == 1:
+        return batches[0]
+
+    def cat(*xs):
+        total = sum(x.shape[0] for x in xs)
+        out = jnp.zeros((total,) + xs[0].shape[1:], xs[0].dtype)
+        offset = 0
+        for x in xs:
+            out = jax.lax.dynamic_update_slice(
+                out, x, (offset,) + (0,) * (x.ndim - 1)
+            )
+            offset += x.shape[0]
+        return out
+
+    return jax.tree_util.tree_map(cat, *batches)

@@ -45,6 +45,24 @@ def set_ep_mesh(mesh: Optional[Mesh]) -> None:
     _EP_MESH = mesh if mesh is not None and dict(mesh.shape).get("ep", 1) > 1 else None
 
 
+def get_ep_mesh() -> Optional[Mesh]:
+    """The currently-installed ep mesh context (None = dense path)."""
+    return _EP_MESH
+
+
+def reset() -> None:
+    """Clear the module-global ep mesh context.
+
+    The context is process state (see module docstring): an MoE trainer
+    installs it and nothing ever uninstalls it, so a later *non*-MoE
+    trace in the same process can silently re-enter the sharded expert
+    path on a stale mesh. Test suites must call this between tests
+    (``tests/conftest.py`` does, autouse); long-lived training processes
+    that build successive trainers should call it when a trainer is
+    discarded."""
+    set_ep_mesh(None)
+
+
 @dataclass
 class GPT2MoEConfig:
     """GPT-2 arch + switch-MoE knobs. Deliberately not a GPT2Config

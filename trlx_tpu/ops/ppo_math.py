@@ -163,7 +163,12 @@ def ppo_loss(
     vf_clipfrac = jnp.sum((vf_loss2 > vf_loss1) * mask) / n
 
     log_ratio = (logprobs - old_logprobs) * mask
-    ratio = jnp.exp(log_ratio)
+    # exp overflow guard: under mixed fsdp/tp meshes the recomputed
+    # logprobs can drift far from the behavior logprobs; exp of an
+    # unclamped log-ratio overflows to inf (then inf * 0 advantages mint
+    # NaN). e^±30 is far outside the surrogate's clip band, so the clamp
+    # never changes a finite loss value.
+    ratio = jnp.exp(jnp.clip(log_ratio, -30.0, 30.0))
     # k3 estimator of KL(new || old) (reference `ppo_models.py:165-169`)
     approx_kl = jnp.sum((ratio - 1.0) - log_ratio) / n
 

@@ -49,6 +49,12 @@ def whiten(
     ``shift_mean=False`` variant used on advantages... (the reference defaults
     True in GAE, `ppo_models.py:137`). Statistics are global across the
     sharded batch automatically under jit.
+
+    The ``+ eps`` under the ``rsqrt`` is load-bearing, not cosmetic: a
+    fully-masked (or constant) batch drives ``var`` to 0 and an eps-free
+    rsqrt to inf. The NaN-flow engine (``trlx_tpu.analysis.nan_flow``)
+    proves this guard from the mask's 0/1 input contract — removing the
+    eps fails ``tpu-lint`` with `nan-unguarded` in CI.
     """
     mean = masked_mean(x, mask)
     var = masked_var(x, mask, mean)

@@ -142,10 +142,17 @@ class BaseRLTrainer(ABC):
                     first_bad = int(np.argmin(finite.ravel()))
                     at = step + first_bad + 1
                     value = float(arr.ravel()[first_bad])
+                mesh_spec = ",".join(
+                    f"{k}={v}" for k, v in dict(self.mesh.shape).items()
+                    if v != 1
+                )
                 raise RuntimeError(
                     f"non-finite {key} ({value}) detected at step {at} — "
-                    "training diverged. Inspect the learning rate / reward "
-                    "scale, or resume from the last checkpoint in "
+                    "training diverged. Localize the first NaN-minting "
+                    "equation with `python -m trlx_tpu.analysis --sanitize "
+                    f"<trainer> --mesh {mesh_spec or 'dp=1'}` "
+                    "(docs/static_analysis.md), inspect the learning rate / "
+                    "reward scale, or resume from the last checkpoint in "
                     f"{self.config.train.checkpoint_dir!r}."
                 )
 
