@@ -380,20 +380,36 @@ def measure_throughput(config, n_phases=5):
     )
 
     times = {"collect": 0.0, "train": 0.0}
+    overlap_saved = {"ms": 0.0, "phases": 0}
     # cost of one forcing fetch = the flat tunnel round trip; subtracted
     # from each train window below so the fetch doesn't inflate the series
     fetch_overhead = measure_fetch_overhead()
+    phase_seed = [0]
 
     def one_phase(record=False):
         trainer.buffer.clear_history()
+        phase_seed[0] += 1
+        # streamed phase (the production default, docs/async_pipeline.md):
+        # epoch-1 updates dispatch during collection; epochs 2..E run as
+        # the fused residual scan in finish_streamed_phase. Falls back to
+        # the legacy fused pass when overlap is disabled in the config.
+        streamed = config.train.phase_overlap
         t0 = time.time()
+        if streamed:
+            trainer.begin_streamed_phase(seed=phase_seed[0])
         orch.make_experience(config.method.num_rollouts, 0)
         # make_experience ends on host-side reward work; the buffer is
         # device-resident, so the collect/train split is the dispatch
-        # boundary here (train_on_buffer's block covers any tail)
+        # boundary here (the train window's block covers any tail — note
+        # that with overlap on, epoch-1 device work already ran inside
+        # the collect window: that is the effect being measured)
         t1 = time.time()
-        # one fused dispatch for all minibatch x ppo_epoch updates
-        _, phase_stats, _ = trainer.train_on_buffer()
+        if streamed:
+            _, phase_rows, _ = trainer.finish_streamed_phase()
+            phase_stats = phase_rows  # host rows already fetched
+        else:
+            # one fused dispatch for all minibatch x ppo_epoch updates
+            _, phase_stats, _ = trainer.train_on_buffer()
         # force with a REAL device->host transfer of a program output:
         # block_until_ready alone intermittently no-ops on the tunneled
         # backend (measured: a 550 ms phase "finishing" in 2.8 ms), which
@@ -406,6 +422,11 @@ def measure_throughput(config, n_phases=5):
         if record:
             times["collect"] += t1 - t0
             times["train"] += (t2 - t1) - fetch_overhead
+            if streamed:
+                overlap_saved["ms"] += trainer._last_overlap_stats.get(
+                    "exp/overlap_saved_ms", 0.0
+                )
+                overlap_saved["phases"] += 1
 
     one_phase()  # warmup: compile sampler + fused train phase
     one_phase()  # second warmup: absorbs any donated-buffer relayout retrace
@@ -452,6 +473,13 @@ def measure_throughput(config, n_phases=5):
         "collect_ms_per_phase": round(times["collect"] / n_phases * 1e3, 1),
         "train_ms_per_phase": round(times["train"] / n_phases * 1e3, 1),
     }
+    if overlap_saved["phases"]:
+        # per-phase estimate of epoch-1 device time hidden under the
+        # collect window by the streamed schedule (docs/async_pipeline.md;
+        # ground truth for the wall-clock delta is ab_phase_overlap.py)
+        out["exp/overlap_saved_ms"] = round(
+            overlap_saved["ms"] / overlap_saved["phases"], 1
+        )
     if peak:
         out["mfu"] = round(achieved_tflops / peak, 4)
         out["bf16_peak_tflops"] = peak

@@ -454,3 +454,108 @@ def test_int8_cache_extends_to_all_causal_families():
 
     with _pytest.raises(ValueError, match="kv_cache_dtype"):
         init_gptj_cache(replace(cases[0][1], kv_cache_dtype="fp8"), 4, 8)
+
+
+def _make_segmented_sampler(
+    config, model, Q, R, segment_size, eos=96, max_length=0
+):
+    """Sampler with an explicit decode_segment_size (0 = monolithic)."""
+    from trlx_tpu.models.gpt2 import init_cache
+    from trlx_tpu.ops.sampling import GenerationConfig, make_sampler
+
+    gen = GenerationConfig(
+        max_new_tokens=R,
+        do_sample=True,
+        eos_token_id=eos,
+        pad_token_id=0,
+        top_k=0,
+        max_length=max_length,
+        decode_segment_size=segment_size,
+    )
+
+    def apply_fn(params, input_ids, attention_mask=None, position_ids=None,
+                 cache=None, cache_index=None):
+        return model.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            position_ids=position_ids, cache=cache, cache_index=cache_index,
+        )
+
+    return make_sampler(
+        apply_fn, functools.partial(init_cache, config), gen, Q
+    )
+
+
+def test_segmented_decode_bitwise_matches_monolithic(tiny_policy):
+    """Early-exit segmented decode: splitting the R-step scan into
+    cond-wrapped segments (skipping the transformer apply once every row
+    finished) must be BITWISE-identical to the monolithic scan — tokens,
+    masks, behavior logprobs, and values. max_length forces every row to
+    finish early DETERMINISTICALLY (row i after max_length - n_real_i
+    tokens), so the all-finished skip branch is guaranteed on the line
+    for the tail segments."""
+    import jax
+    import jax.numpy as jnp
+
+    config, model, params = tiny_policy
+    Q, R, B = 4, 8, 4
+    rng = np.random.default_rng(2)
+    ids = np.zeros((B, Q), np.int32)
+    mask = np.zeros((B, Q), np.int32)
+    for i, L in enumerate([4, 3, 2, 1]):
+        ids[i, Q - L:] = rng.integers(1, 96, size=L)
+        mask[i, Q - L:] = 1
+
+    # max_length=6: rows finish at t = 6 - n_real - 1 = [1, 2, 3, 4];
+    # all finished from t=5 on -> segments covering [5, 8) skip
+    mono = jax.jit(
+        _make_segmented_sampler(config, model, Q, R, 0, max_length=6)
+    )
+    # segment_size 2: real multi-step segments; 3: gcd(8,3)=1, the
+    # per-step cond fallback (one jitted monolith serves both)
+    for segment_size in (2, 3):
+        segd = jax.jit(
+            _make_segmented_sampler(
+                config, model, Q, R, segment_size, max_length=6
+            )
+        )
+        for seed in range(2):
+            key = jax.random.PRNGKey(seed)
+            a = mono(params, jnp.asarray(ids), jnp.asarray(mask), key)
+            b = segd(params, jnp.asarray(ids), jnp.asarray(mask), key)
+            for name in ("tokens", "response_mask", "logprobs", "values"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a, name)),
+                    np.asarray(getattr(b, name)),
+                    err_msg=f"{name} (seed {seed}, segment {segment_size})",
+                )
+            lengths = np.asarray(a.response_mask).sum(axis=1)
+            # max_length caps row i at 6 - n_real_i live tokens (a
+            # sampled eos may finish a row even earlier)
+            assert (lengths <= np.array([2, 3, 4, 5])).all(), lengths
+            # the tail past t=5 is all-finished: segments there take
+            # the skip branch; emissions are pad/zeros
+            assert (np.asarray(a.tokens)[:, 5:] == 0).all()
+            assert (np.asarray(a.response_mask)[:, 5:] == 0).all()
+
+
+def test_finished_rows_emit_deterministic_zeros(tiny_policy):
+    """Post-finish slots emit logprob 0.0 and value 0.0 (mask is 0 there;
+    training consumes neither) — the invariant that makes the segmented
+    skip branch exact and keeps masked slots independent of post-eos
+    logits."""
+    import jax
+    import jax.numpy as jnp
+
+    config, model, params = tiny_policy
+    Q, R, B = 4, 8, 8
+    sampler = jax.jit(_make_segmented_sampler(config, model, Q, R, 2, eos=3))
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, 96, size=(B, Q)), jnp.int32
+    )
+    mask = jnp.ones((B, Q), jnp.int32)
+    out = sampler(params, ids, mask, jax.random.PRNGKey(1))
+    m = np.asarray(out.response_mask).astype(bool)
+    assert not m.all(), "need at least one finished row for the assertion"
+    assert (np.asarray(out.logprobs)[~m] == 0.0).all()
+    assert (np.asarray(out.values)[~m] == 0.0).all()
+    assert (np.asarray(out.tokens)[~m] == 0).all()  # pad_token_id

@@ -54,6 +54,14 @@ def main(argv=None) -> int:
         "self-check that the replay detects and attributes it",
     )
     parser.add_argument(
+        "--streamed",
+        action="store_true",
+        help="with --sanitize: replay the overlapped phase's streamed "
+        "epoch-1 step — the minibatch is gathered from the streaming "
+        "buffer after chunked dynamic_update_slice landings, the way "
+        "the streamed dispatcher produces it",
+    )
+    parser.add_argument(
         "--paths",
         nargs="*",
         default=None,
@@ -97,7 +105,8 @@ def main(argv=None) -> int:
                 for k, v in (kv.split("=") for kv in args.mesh.split(","))
             }
         result = sanitize_trainer(
-            args.sanitize, mesh=mesh, plant=args.plant_nan
+            args.sanitize, mesh=mesh, plant=args.plant_nan,
+            streamed=args.streamed,
         )
         report = result.to_report()
         print(report.to_json() if args.json else result.format_text())

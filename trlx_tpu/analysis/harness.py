@@ -367,7 +367,11 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
 
     if kind != "ilql":
         # the fused buffer pass (scan over stacked minibatches) is the
-        # production train path — audit it too, with its own donation
+        # production train path — audit it too, with its own donation.
+        # Under the streamed collect→train phase (docs/async_pipeline.md)
+        # this same program runs the residual epochs 2..ppo_epochs, and
+        # `train_step` above IS the streamed epoch-1 step — both streamed
+        # dispatch modes are covered by these traces.
         stacked = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct((2,) + x.shape, x.dtype), mb
         )
@@ -381,6 +385,23 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
                 n_donated_state_leaves=n_state,
                 input_paths=flat_input_paths(
                     state_sds, stacked, prefixes=("state", "batch")
+                ),
+            )
+        )
+        # the streamed phase's behavior-policy snapshot (compute-dtype
+        # cast + donation-safe per-leaf copy): every sampler/ref forward
+        # of an overlapped phase consumes its output, so its dtype story
+        # belongs in the audit
+        params_sds = _sds(trainer.state.params)
+        programs.append(
+            TracedProgram(
+                subject=f"{kind}.behavior_snapshot",
+                closed_jaxpr=jax.make_jaxpr(
+                    trainer._behavior_snapshot_jit
+                )(params_sds),
+                mesh_axes=axes,
+                input_paths=flat_input_paths(
+                    params_sds, prefixes=("params",)
                 ),
             )
         )

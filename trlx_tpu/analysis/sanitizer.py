@@ -366,9 +366,20 @@ def sanitize_trainer(
     mesh: Optional[Dict[str, int]] = None,
     plant: bool = False,
     seed: int = 0,
+    streamed: bool = False,
 ) -> SanitizeResult:
     """Build the tiny harness trainer, capture its train-step jaxpr over
-    concrete (state, batch), and replay eqn-by-eqn."""
+    concrete (state, batch), and replay eqn-by-eqn.
+
+    ``streamed=True`` replays the *streamed* epoch-1 step of the
+    overlapped collect→train phase (docs/async_pipeline.md): the
+    minibatch is produced the way the streamed dispatcher produces it —
+    rollout rows land chunk-by-chunk in the streaming buffer
+    (``dynamic_update_slice`` writes, the SPMD-safe path) and the
+    replayed step consumes the first plan minibatch gathered from the
+    partially-identical store — so sharded-store corruption of the class
+    the PR-2 concat bug belonged to shows up as the replay's first
+    non-finite equation."""
     import jax
 
     from trlx_tpu.analysis import harness
@@ -378,6 +389,31 @@ def sanitize_trainer(
     if plant:
         state = plant_nan(state)
     mb = harness.concrete_minibatch(trainer, kind, seed=seed)
+    subject = f"{kind}.train_step"
+    if streamed:
+        if kind == "ilql":
+            raise ValueError(
+                "--streamed replays the PPO-family streamed phase; ILQL "
+                "has no streamed collect→train path"
+            )
+        from trlx_tpu.pipeline.ppo_buffer import make_stream_plan
+
+        B = trainer.config.train.batch_size
+        plan = make_stream_plan(
+            B, B, trainer.config.method.ppo_epochs, seed
+        )
+        trainer.buffer.clear_history()
+        trainer.buffer.begin_stream(plan.total)
+        half = max(B // 2, 1)
+        trainer.buffer.push(jax.tree_util.tree_map(lambda x: x[:half], mb))
+        if half < B:
+            trainer.buffer.push(
+                jax.tree_util.tree_map(lambda x: x[half:], mb)
+            )
+        mb = trainer.buffer.gather(
+            plan.epoch1[0], sharding=trainer._batch_sh
+        )
+        subject = f"{kind}.streamed_step"
     closed = jax.make_jaxpr(trainer._train_step_jit)(state, mb)
     args = jax.tree_util.tree_leaves((state, mb))
     names = _flat_input_names(state, mb)
@@ -385,7 +421,7 @@ def sanitize_trainer(
     return sanitize_jaxpr(
         closed,
         args,
-        subject=f"{kind}.train_step" + (".planted" if plant else ""),
+        subject=subject + (".planted" if plant else ""),
         mesh=mesh_shape,
         arg_names=names,
     )

@@ -99,9 +99,13 @@ def train(
             )
         # bind eval BEFORE the first collection: add_eval_pipeline may
         # expand the decode budget (bind_prompt_budget), and doing so after
-        # make_experience would discard the just-compiled sampler
+        # make_experience would discard the just-compiled sampler.
         trainer.add_eval_pipeline(eval_pipeline)
-        orch.make_experience(config.method.num_rollouts, 0)
+        # The first collection is learn()'s (it collects when the buffer
+        # is empty): that way it runs as a streamed phase with epoch-1
+        # updates overlapping the decode (docs/async_pipeline.md) instead
+        # of a plain serial pre-collection here, and a resumed-finished
+        # run skips collection entirely.
         trainer.learn()
         return trainer
 

@@ -187,6 +187,26 @@ class TrainConfig:
     # scales / relative bias are consumed at f32).
     rollout_param_cast: bool = True
 
+    # Streamed collect→train phase overlap (PPO-family trainers;
+    # docs/async_pipeline.md): the behavior policy is snapshotted once per
+    # phase, rollout chunks land incrementally in the streaming buffer, and
+    # epoch-1 minibatch updates are dispatched as soon as each planned
+    # minibatch's rollouts exist — while later chunks are still decoding.
+    # Exactly on-policy (every rollout samples from the frozen snapshot;
+    # behavior logprobs are recorded at decode time) and bitwise-identical
+    # to running the same schedule serially (tests/test_phase_overlap.py).
+    # NOTE the streamed UPDATE SCHEDULE itself differs from the legacy
+    # fused/stepwise one (and from the torch reference): epoch-MAJOR
+    # (epoch 1 over arrival-block minibatches, then epochs 2..E over
+    # fresh global permutations) instead of minibatch-major (each
+    # shuffled minibatch repeated ppo_epochs times consecutively). Both
+    # are standard PPO; reproducing a pre-overlap run exactly requires
+    # phase_overlap: false. Passes with a mid-pass eval/checkpoint
+    # boundary, a total_steps cutoff, or an active profiler fall back to
+    # the legacy fused/stepwise paths automatically. False disables
+    # streaming entirely (legacy schedule everywhere).
+    phase_overlap: bool = True
+
     # when set, every collected rollout chunk is appended (one JSON line per
     # sample: query/response text + raw score) to rollouts_<iter>.jsonl here
     rollout_logging_dir: Optional[str] = None

@@ -22,6 +22,7 @@ sequence with pad fill (`ilql_models.py:314-325` semantics).
 from __future__ import annotations
 
 import dataclasses
+import math
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -64,6 +65,15 @@ class GenerationConfig:
     # `ppo_models.py:620-622`); -1 = disabled
     forced_bos_token_id: int = -1
     decoder_start_token_id: int = 0
+    # Early-exit segmented decode (causal sampler): the R-step scan runs as
+    # fixed segments of gcd(R, decode_segment_size) steps, each wrapped in a
+    # lax.cond that skips the transformer apply once EVERY row has finished
+    # — the compiled program keeps static shapes but stops paying the
+    # per-token forward for all-pad tail steps (EOS-heavy workloads
+    # otherwise burn the full max_new_tokens budget emitting pad). 0
+    # disables segmentation (one monolithic scan). Segmented and monolithic
+    # decode are bitwise-identical (tests/test_sampling.py).
+    decode_segment_size: int = 8
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "GenerationConfig":
@@ -84,7 +94,7 @@ class GenerationConfig:
         for name in ("max_new_tokens", "min_new_tokens", "min_length",
                      "max_length", "top_k",
                      "eos_token_id", "pad_token_id", "forced_bos_token_id",
-                     "decoder_start_token_id"):
+                     "decoder_start_token_id", "decode_segment_size"):
             if name in d and d[name] is not None:
                 d[name] = int(d[name])
         return cls(**d)
@@ -274,6 +284,14 @@ def make_sampler(
                 - jax.scipy.special.logsumexp(logits_last, axis=-1)
             )
             live = jnp.logical_not(finished)
+            # finished rows emit deterministic zeros for logprob/value
+            # (these slots are response_mask==0 everywhere downstream):
+            # the emissions then depend only on `finished`, never on the
+            # post-finish logits/values — which is what lets the segmented
+            # decode skip the transformer apply for an all-finished
+            # segment and stay bitwise-identical to the monolithic scan.
+            logprob = jnp.where(live, logprob, 0.0)
+            value_out = jnp.where(live, value_last, 0.0)
             finished = jnp.logical_or(finished, token == gen_config.eos_token_id)
             if gen_config.max_length > 0:
                 # HF total-length cap: prompt + generated >= max_length
@@ -281,7 +299,7 @@ def make_sampler(
                     finished, n_real + t + 1 >= gen_config.max_length
                 )
 
-            ys = (token, live.astype(jnp.int32), logprob, value_last)
+            ys = (token, live.astype(jnp.int32), logprob, value_out)
 
             # forward the sampled token at slot Q+t
             cache_mask_t = (slot_ids <= Q + t).astype(jnp.int32) * jnp.concatenate(
@@ -308,11 +326,58 @@ def make_sampler(
             finished0 = n_real >= gen_config.max_length
         else:
             finished0 = jnp.zeros((B,), bool)
-        (_, _, _, _, _), (tokens, mask, logprobs, values) = jax.lax.scan(
-            step,
-            (cache, logits_last, value_last, finished0, rng),
-            jnp.arange(R),
+        carry0 = (cache, logits_last, value_last, finished0, rng)
+
+        seg = (
+            math.gcd(R, gen_config.decode_segment_size)
+            if gen_config.decode_segment_size > 0
+            else R
         )
+        n_seg = R // seg
+        if n_seg <= 1:
+            # monolithic scan: every step runs the transformer apply
+            _, (tokens, mask, logprobs, values) = jax.lax.scan(
+                step, carry0, jnp.arange(R)
+            )
+        else:
+            # Early-exit segmented decode: scan over n_seg segments of
+            # `seg` steps; once every row is finished the segment's cond
+            # takes the skip branch — no transformer apply, no cache
+            # update. Bitwise-identical to the monolithic scan: finished
+            # rows emit (pad, 0, 0.0, 0.0) regardless of branch, the RNG
+            # carry advances by exactly one split per step in both
+            # branches, and rows never un-finish, so the stale
+            # cache/logits carried past a skipped segment are never read.
+            def run_seg(carry, ts):
+                return jax.lax.scan(step, carry, ts)
+
+            def skip_seg(carry, ts):
+                cache, logits_last, value_last, finished, rng = carry
+
+                def skip_step(r, t):
+                    return jax.random.split(r)[0], None
+
+                rng, _ = jax.lax.scan(skip_step, rng, ts)
+                k = ts.shape[0]
+                ys = (
+                    jnp.full((k, B), gen_config.pad_token_id, jnp.int32),
+                    jnp.zeros((k, B), jnp.int32),
+                    jnp.zeros((k, B), jnp.float32),
+                    jnp.zeros((k, B), jnp.float32),
+                )
+                return (cache, logits_last, value_last, finished, rng), ys
+
+            def seg_body(carry, ts):
+                return jax.lax.cond(
+                    jnp.all(carry[3]), skip_seg, run_seg, carry, ts
+                )
+
+            _, (tokens, mask, logprobs, values) = jax.lax.scan(
+                seg_body, carry0, jnp.arange(R).reshape(n_seg, seg)
+            )
+            tokens, mask, logprobs, values = (
+                x.reshape(R, B) for x in (tokens, mask, logprobs, values)
+            )
         return SampleOutput(
             tokens=tokens.T,
             response_mask=mask.T,

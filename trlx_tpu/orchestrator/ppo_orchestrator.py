@@ -19,7 +19,6 @@ in the rollout loop"):
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import Callable
@@ -85,6 +84,14 @@ class PPOOrchestrator(Orchestrator):
         # pid suffix: two jobs sharing a rollout_logging_dir that start in
         # the same second must still get distinct run directories
         self._run_id = f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}"
+        # rollout JSONL writes run on a background thread so host file
+        # I/O never sits on the collect critical path; drained at every
+        # phase end (and on exceptions) by make_experience
+        self._rollout_writer = None
+        if trainer.config.train.rollout_logging_dir and is_main_process():
+            from trlx_tpu.utils.async_writer import BackgroundJSONLWriter
+
+            self._rollout_writer = BackgroundJSONLWriter()
 
     def _expand_groups(self, batch, meta):
         """Grouped-baseline support (GRPO): when the trainer declares
@@ -116,22 +123,29 @@ class PPOOrchestrator(Orchestrator):
         )
 
     def _log_rollouts(self, queries, texts, scores, iter_count: int) -> None:
-        """Append collected rollouts to ``train.rollout_logging_dir`` as
-        JSON lines (query/response/raw score), rank-0 only. Each run writes
-        under its own ``run_<timestamp>`` subdirectory so a resumed/re-run
-        job reusing the directory never appends rows indistinguishable from
-        an earlier run's."""
-        directory = self.trainer.config.train.rollout_logging_dir
-        if not directory or not is_main_process():
+        """Enqueue collected rollouts for ``train.rollout_logging_dir`` as
+        JSON lines (query/response/raw score), rank-0 only — the writes
+        happen on the background writer thread, never on the collect
+        critical path; ``make_experience`` drains the queue at phase end
+        (and on exceptions, so already-queued rows survive a crash). Each
+        run writes under its own ``run_<timestamp>`` subdirectory so a
+        resumed/re-run job reusing the directory never appends rows
+        indistinguishable from an earlier run's."""
+        if self._rollout_writer is None:
             return
-        directory = os.path.join(directory, f"run_{self._run_id}")
+        directory = os.path.join(
+            self.trainer.config.train.rollout_logging_dir,
+            f"run_{self._run_id}",
+        )
         safe_mkdir(directory)
         path = os.path.join(directory, f"rollouts_{iter_count}.jsonl")
-        with open(path, "a") as f:
-            for q, s, r in zip(queries, texts, scores):
-                f.write(json.dumps(
-                    {"query": q, "response": s, "score": float(r)}
-                ) + "\n")
+        self._rollout_writer.submit(
+            path,
+            [
+                {"query": q, "response": s, "score": float(r)}
+                for q, s, r in zip(queries, texts, scores)
+            ],
+        )
 
     def _dispatch_chunk(self):
         """Enqueue one chunk's device work (sampler + frozen-ref forward)
@@ -175,82 +189,107 @@ class PPOOrchestrator(Orchestrator):
         # Double-buffered collection: chunk k+1's device work is enqueued
         # before chunk k's host-side detokenize + reward run, so the device
         # never idles between chunks. All chunks sample from the same policy
-        # params (no update happens inside a collection phase), so the
-        # pipelining is exactly on-policy — same semantics as the
-        # reference's sequential loop (`ppo_orchestrator.py:66-196`).
-        pending = self._dispatch_chunk()
-        while collected < num_rollouts:
-            batch, meta, sample_out, ref_logprobs, dispatch_ms = pending
-            dispatch_time += dispatch_ms / 1000.0
-            if collected + len(batch.input_ids) < num_rollouts:
-                pending = self._dispatch_chunk()
+        # params — either literally no update happens inside the phase, or
+        # (streamed phase, docs/async_pipeline.md) every sampler/ref
+        # forward runs on the trainer's frozen behavior snapshot while
+        # epoch-1 updates land underneath — so the pipelining is exactly
+        # on-policy: same semantics as the reference's sequential loop
+        # (`ppo_orchestrator.py:66-196`).
+        streamed_hook = getattr(self.trainer, "on_rollouts_landed", None)
+        try:
+            pending = self._dispatch_chunk()
+            while collected < num_rollouts:
+                batch, meta, sample_out, ref_logprobs, dispatch_ms = pending
+                dispatch_time += dispatch_ms / 1000.0
+                if collected + len(batch.input_ids) < num_rollouts:
+                    pending = self._dispatch_chunk()
 
-            # time-to-tokens-available: decode_responses blocks on the
-            # device->host copy of the sampler's output, so this is where
-            # generation cost actually lands (the reference's
-            # exp_generate_time meaning); dispatch_time alone reads ~0
-            # because the sampler call above only enqueues work.
-            t = Clock()
-            texts = self.trainer.decode_responses(
-                sample_out.tokens, sample_out.response_mask
-            )
-            generate_time += t.tick() / 1000.0
-            if meta["prompts_text"][0] is not None:
-                queries = meta["prompts_text"]
-            else:
-                queries = self.trainer.decode_queries(
-                    batch.input_ids, batch.attention_mask
+                # time-to-tokens-available: decode_responses blocks on the
+                # device->host copy of the sampler's output, so this is
+                # where generation cost actually lands (the reference's
+                # exp_generate_time meaning); dispatch_time alone reads ~0
+                # because the sampler call above only enqueues work.
+                t = Clock()
+                texts = self.trainer.decode_responses(
+                    sample_out.tokens, sample_out.response_mask
+                )
+                generate_time += t.tick() / 1000.0
+                if meta["prompts_text"][0] is not None:
+                    queries = meta["prompts_text"]
+                else:
+                    queries = self.trainer.decode_queries(
+                        batch.input_ids, batch.attention_mask
+                    )
+
+                t = Clock()
+                scores = np.asarray(
+                    self.score(texts, queries, meta["response_gt"]),
+                    dtype=np.float32,
+                )
+                score_time += t.tick() / 1000.0
+                all_scores.append(scores.copy())
+                self._log_rollouts(queries, texts, scores, iter_count)
+
+                # reward scaling + clip (`ppo_orchestrator.py:96-112`). The
+                # reference seeds ref stats from the first rollout batch
+                # when unset (`:97-98`) and always advances the running
+                # moments.
+                if self.ref_mean is None:
+                    self.ref_mean, self.ref_std = (
+                        float(scores.mean()), float(scores.std())
+                    )
+                self.running.update(scores)
+                if method.scale_reward == "running":
+                    if self.running.std > 0:
+                        scores = scores / self.running.std
+                elif method.scale_reward == "ref" and self.ref_std:
+                    scores = scores / self.ref_std
+                elif method.scale_reward == "group":
+                    # whiten within each same-prompt group (beyond parity;
+                    # rows are group-contiguous via _expand_groups)
+                    from trlx_tpu.ops.ppo_math import group_whiten
+
+                    scores = group_whiten(scores, self.group_size)
+                if method.cliprange_reward:
+                    scores = np.clip(
+                        scores, -method.cliprange_reward,
+                        method.cliprange_reward,
+                    )
+
+                rewards = self.trainer.compute_rewards(
+                    sample_out.logprobs,
+                    ref_logprobs,
+                    sample_out.response_mask,
+                    scores,
                 )
 
-            t = Clock()
-            scores = np.asarray(
-                self.score(texts, queries, meta["response_gt"]), dtype=np.float32
-            )
-            score_time += t.tick() / 1000.0
-            all_scores.append(scores.copy())
-            self._log_rollouts(queries, texts, scores, iter_count)
-
-            # reward scaling + clip (`ppo_orchestrator.py:96-112`). The
-            # reference seeds ref stats from the first rollout batch when
-            # unset (`:97-98`) and always advances the running moments.
-            if self.ref_mean is None:
-                self.ref_mean, self.ref_std = float(scores.mean()), float(scores.std())
-            self.running.update(scores)
-            if method.scale_reward == "running":
-                if self.running.std > 0:
-                    scores = scores / self.running.std
-            elif method.scale_reward == "ref" and self.ref_std:
-                scores = scores / self.ref_std
-            elif method.scale_reward == "group":
-                # whiten within each same-prompt group (beyond parity;
-                # rows are group-contiguous via _expand_groups)
-                from trlx_tpu.ops.ppo_math import group_whiten
-
-                scores = group_whiten(scores, self.group_size)
-            if method.cliprange_reward:
-                scores = np.clip(
-                    scores, -method.cliprange_reward, method.cliprange_reward
+                self.trainer.buffer.push(
+                    PPORolloutBatch(
+                        query_tokens=batch.input_ids,
+                        query_mask=batch.attention_mask,
+                        response_tokens=sample_out.tokens,
+                        response_mask=sample_out.response_mask,
+                        logprobs=sample_out.logprobs,
+                        values=sample_out.values,
+                        rewards=rewards,
+                    )
                 )
+                collected += len(batch)
+                if streamed_hook is not None:
+                    # streamed phase: let the trainer dispatch every
+                    # epoch-1 minibatch whose rollouts have now landed
+                    # (no-op outside an active stream)
+                    streamed_hook()
+        finally:
+            if self._rollout_writer is not None:
+                # drain queued rows to disk even when collection raised;
+                # surface writer errors only on the clean path (an active
+                # exception wins)
+                import sys
 
-            rewards = self.trainer.compute_rewards(
-                sample_out.logprobs,
-                ref_logprobs,
-                sample_out.response_mask,
-                scores,
-            )
-
-            self.trainer.buffer.push(
-                PPORolloutBatch(
-                    query_tokens=batch.input_ids,
-                    query_mask=batch.attention_mask,
-                    response_tokens=sample_out.tokens,
-                    response_mask=sample_out.response_mask,
-                    logprobs=sample_out.logprobs,
-                    values=sample_out.values,
-                    rewards=rewards,
+                self._rollout_writer.flush(
+                    reraise=sys.exc_info()[0] is None
                 )
-            )
-            collected += len(batch)
 
         exp_time = clock.tick() / 1000.0
         scores_cat = np.concatenate(all_scores)

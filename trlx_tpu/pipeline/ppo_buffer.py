@@ -6,10 +6,29 @@ with an append-of-batches pytree that never leaves the device: rollout
 chunks arrive already batched/padded from the jitted sampler, minibatch
 sampling is a device-side gather, and experience feeds the jitted train step
 with zero host round-trips (SURVEY §7.1).
+
+Two accumulation modes:
+
+- **chunk mode** (default): chunks append to a list; the full buffer is
+  materialized lazily via :func:`~trlx_tpu.data.ppo_types.concat_rollouts`.
+- **stream mode** (:meth:`PPORolloutBuffer.begin_stream`): rows land
+  incrementally in a preallocated device store via ``dynamic_update_slice``
+  writes (NEVER ``jnp.concatenate`` of committed-sharded chunks — the XLA
+  SPMD mis-lowering documented in ``concat_rollouts``), so minibatches can
+  be gathered *while collection is still running*. This is the substrate of
+  the overlapped collect→train phase (docs/async_pipeline.md): the trainer
+  dispatches epoch-1 PPO updates as soon as each planned minibatch's
+  constituent rollouts have landed.
+
+:class:`StreamPlan` fixes the entire phase's minibatch permutation up front
+from the (known) total rollout count, so the overlapped and serial schedules
+consume bitwise-identical minibatch slices in the same order — the
+overlap is purely a dispatch reordering, never a data reordering.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Iterator, List, Optional
 
 import jax
@@ -20,24 +39,175 @@ from trlx_tpu.data.ppo_types import PPORolloutBatch, concat_rollouts
 from trlx_tpu.pipeline import BaseRolloutStore
 
 
+@dataclass(frozen=True)
+class StreamPlan:
+    """The full update schedule of one streamed collect→train phase,
+    computed before the first rollout lands.
+
+    Epoch 1 minibatches are the *arrival blocks*: minibatch ``k`` is rows
+    ``[k·B, (k+1)·B)`` in landing order, dispatchable the moment
+    ``(k+1) * batch_size`` rollouts exist — maximal collect/train
+    overlap. No within-block shuffle: a minibatch gradient is invariant
+    to row order inside the batch, so the randomness of epoch-1
+    minibatch composition comes entirely from the pipeline's shuffled
+    prompt draw (arrival order IS a random draw). Epochs 2..ppo_epochs
+    each use a fresh *global* permutation (all rows are available by
+    then) and run as one fused scan after collection.
+
+    Both the overlapped and the serial execution of a phase follow this
+    same plan, which is what makes them bitwise-comparable.
+    """
+
+    total: int  # rollouts the schedule covers (n_minibatches * batch_size)
+    batch_size: int
+    ppo_epochs: int
+    epoch1: np.ndarray  # [n_minibatches, batch_size] row indices
+    residual: np.ndarray  # [n_minibatches * (ppo_epochs-1), batch_size]
+
+    @property
+    def n_minibatches(self) -> int:
+        return self.epoch1.shape[0]
+
+    @property
+    def n_updates(self) -> int:
+        return self.n_minibatches * self.ppo_epochs
+
+    def rows_needed(self, k: int) -> int:
+        """Rollouts that must have landed before epoch-1 minibatch ``k``
+        (0-based) can be dispatched."""
+        return (k + 1) * self.batch_size
+
+    def ready(self, k: int, landed: int) -> bool:
+        return landed >= self.rows_needed(k)
+
+
+def make_stream_plan(
+    total: int, batch_size: int, ppo_epochs: int, seed: int = 0
+) -> StreamPlan:
+    """Build the phase schedule for ``total`` rollouts (extra rows a
+    non-dividing final chunk over-collects are stored but not scheduled)."""
+    n_mb = total // batch_size
+    if n_mb < 1:
+        raise ValueError(
+            f"stream plan needs at least one minibatch "
+            f"({total} rollouts < batch_size {batch_size})"
+        )
+    rng = np.random.default_rng(seed)
+    n_sched = n_mb * batch_size
+    epoch1 = np.arange(n_sched).reshape(n_mb, batch_size)
+    residual = (
+        np.stack(
+            [rng.permutation(n_sched) for _ in range(ppo_epochs - 1)]
+        ).reshape(n_mb * (ppo_epochs - 1), batch_size)
+        if ppo_epochs > 1
+        else np.zeros((0, batch_size), np.int64)
+    )
+    return StreamPlan(
+        total=n_sched,
+        batch_size=batch_size,
+        ppo_epochs=ppo_epochs,
+        epoch1=epoch1,
+        residual=residual,
+    )
+
+
+def _alloc_store(chunk: PPORolloutBatch, capacity: int) -> PPORolloutBatch:
+    """Fresh zero store of ``capacity`` rows with ``chunk``'s trailing
+    shapes/dtypes."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros((capacity,) + x.shape[1:], x.dtype), chunk
+    )
+
+
+def _write_rows(
+    store: PPORolloutBatch, chunk: PPORolloutBatch, offset: int
+) -> PPORolloutBatch:
+    """Write ``chunk``'s rows into ``store`` at ``offset`` via
+    ``dynamic_update_slice`` (resolves committed chunk shardings correctly
+    on every mesh — see ``concat_rollouts`` for why concatenate must not
+    be used here)."""
+    return jax.tree_util.tree_map(
+        lambda s, x: jax.lax.dynamic_update_slice(
+            s, x.astype(s.dtype), (offset,) + (0,) * (x.ndim - 1)
+        ),
+        store,
+        chunk,
+    )
+
+
 class PPORolloutBuffer(BaseRolloutStore):
     """Accumulates fixed-shape rollout chunks; serves shuffled minibatches."""
 
     def __init__(self):
         self._chunks: List[PPORolloutBatch] = []
         self._full: Optional[PPORolloutBatch] = None
+        self._store: Optional[PPORolloutBatch] = None  # stream-mode store
+        self._capacity = 0
+        self._landed = 0
+        self._streaming = False
+
+    def begin_stream(self, capacity: int) -> None:
+        """Switch to incremental stream mode for the coming phase.
+
+        ``capacity`` is the planned rollout total; a final chunk that
+        overshoots it grows the store. Requires an empty buffer (the
+        stream is a whole phase; call :meth:`clear_history` first)."""
+        if len(self):
+            raise ValueError(
+                "begin_stream on a non-empty buffer — clear_history() "
+                "the previous phase's experience first"
+            )
+        if capacity < 1:
+            raise ValueError(f"stream capacity must be >= 1, got {capacity}")
+        self._streaming = True
+        self._store = None
+        self._capacity = int(capacity)
+        self._landed = 0
+        self._full = None
+
+    @property
+    def streaming(self) -> bool:
+        return self._streaming
 
     def push(self, batch: PPORolloutBatch) -> None:
-        self._chunks.append(batch)
+        if not self._streaming:
+            self._chunks.append(batch)
+            self._full = None
+            return
+        n = batch.batch_size
+        if self._store is None:
+            self._store = _alloc_store(batch, max(self._capacity, n))
+            self._capacity = self._store.batch_size
+        if self._landed + n > self._capacity:
+            # a non-dividing final chunk overshoots the planned capacity:
+            # grow the store (same dynamic_update_slice discipline)
+            grown = _alloc_store(batch, self._landed + n)
+            grown = _write_rows(grown, self._store, 0)
+            self._store, self._capacity = grown, self._landed + n
+        self._store = _write_rows(self._store, batch, self._landed)
+        self._landed += n
         self._full = None
 
     def clear_history(self) -> None:
         """Drop all experience (on-policy refresh, `ppo_pipeline.py:25-26`)."""
         self._chunks = []
         self._full = None
+        self._store = None
+        self._capacity = 0
+        self._landed = 0
+        self._streaming = False
 
     @property
     def full(self) -> PPORolloutBatch:
+        if self._streaming:
+            if self._store is None:
+                raise ValueError("rollout buffer is empty")
+            if self._landed == self._store.batch_size:
+                return self._store
+            # static python-int slice of the landed prefix
+            return jax.tree_util.tree_map(
+                lambda x: x[: self._landed], self._store
+            )
         if self._full is None:
             if not self._chunks:
                 raise ValueError("rollout buffer is empty")
@@ -49,7 +219,28 @@ class PPORolloutBuffer(BaseRolloutStore):
         return self._full
 
     def __len__(self) -> int:
+        if self._streaming:
+            return self._landed
         return sum(c.batch_size for c in self._chunks)
+
+    def gather(self, idx: np.ndarray, sharding=None) -> PPORolloutBatch:
+        """Device-side gather of rows by index — ``idx`` may be [B] (one
+        minibatch) or [n, B] (stacked minibatches for the fused phase).
+        In stream mode every index must already have landed."""
+        idx = np.asarray(idx)
+        # idx is HOST numpy by contract (plan indices): the int() never
+        # touches a device value, and every host runs the identical plan,
+        # so this branch cannot desynchronize hosts.
+        if self._streaming and idx.size and int(idx.max()) >= self._landed:  # tpu-lint: disable=host-branch
+            raise ValueError(
+                f"gather of row {int(idx.max())} but only "
+                f"{self._landed} rollouts have landed"
+            )
+        source = self._store if self._streaming else self.full
+        mb = source.select(jnp.asarray(idx))
+        if sharding is not None:
+            mb = jax.device_put(mb, sharding)
+        return mb
 
     def create_loader(
         self,
@@ -84,6 +275,7 @@ class PPORolloutBuffer(BaseRolloutStore):
         seed: int = 0,
         sharding=None,
         repeat: int = 1,
+        n_minibatches: Optional[int] = None,
     ) -> PPORolloutBatch:
         """All minibatches of one buffer pass as a single [n_mb*repeat, B,
         ...] pytree — the input of the fused (one-dispatch) train phase,
@@ -101,6 +293,12 @@ class PPORolloutBuffer(BaseRolloutStore):
         n_mb = n // batch_size
         if n_mb == 0:
             raise ValueError(f"buffer smaller than one minibatch ({n} < {batch_size})")
+        if n_minibatches is not None:
+            # caller-fixed pass size (learn() sizes every pass from the
+            # PLANNED rollout count so step accounting agrees across the
+            # streamed and fused paths even when a non-dividing final
+            # chunk over-collected the buffer)
+            n_mb = min(n_mb, n_minibatches)
         order = np.arange(n)
         if shuffle:
             np.random.default_rng(seed).shuffle(order)

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -59,7 +60,11 @@ from trlx_tpu.parallel import (
     make_mesh,
     replicated,
 )
-from trlx_tpu.pipeline.ppo_buffer import PPORolloutBuffer
+from trlx_tpu.pipeline.ppo_buffer import (
+    PPORolloutBuffer,
+    StreamPlan,
+    make_stream_plan,
+)
 from trlx_tpu.trainer import BaseRLTrainer, register_trainer
 from trlx_tpu.trainer.common import (
     TrainState,
@@ -113,6 +118,21 @@ def _policy_entropy(logits: jax.Array) -> jax.Array:
     l = logits.astype(jnp.float32)
     p = jax.nn.softmax(l, axis=-1)
     return jax.scipy.special.logsumexp(l, axis=-1) - jnp.sum(p * l, axis=-1)
+
+
+class _StreamedPhase:
+    """Host-side state of one streamed collect→train phase
+    (docs/async_pipeline.md): the fixed update plan, the dispatch cursor
+    over epoch-1 minibatches, their pending stats, and the wall-clock
+    marks the overlap attribution is computed from."""
+
+    def __init__(self, plan: StreamPlan, overlap: bool):
+        self.plan = plan
+        self.overlap = overlap
+        self.next_mb = 0  # epoch-1 minibatches dispatched so far
+        self.epoch1_stats: List[Dict[str, jax.Array]] = []
+        self.t_first_dispatch: Optional[float] = None
+        self.dispatched_during_collect = 0
 
 
 @register_trainer
@@ -297,6 +317,15 @@ class PPOTrainer(BaseRLTrainer):
         self.buffer = PPORolloutBuffer()
         self.kl_coef = float(method.init_kl_coef)
         self.mean_kl = 0.0
+        # streamed collect→train phase state (docs/async_pipeline.md):
+        # while a phase is active, `_behavior_params` is the frozen
+        # behavior-policy snapshot every sampler/ref forward runs on —
+        # epoch-1 updates mutate `self.state` underneath without touching
+        # rollout semantics.
+        self._stream: Optional[_StreamedPhase] = None
+        self._behavior_params = None
+        self._last_overlap_stats: Dict[str, float] = {}
+        self._last_phase_mean_kl = 0.0
 
         self.setup_ep_axis(self.mesh, self.family)
         # MoE families contribute router load-balancing losses to the
@@ -323,6 +352,7 @@ class PPOTrainer(BaseRLTrainer):
         ``router`` — stay f32 so outputs are bit-identical."""
         self._rollout_cast_jit = None
         self._rollout_params_cache = None
+        self._rollout_compute_dtype = None
         cdtype = jnp.dtype(getattr(self.model_config, "dtype", train.dtype))
         # the params' ACTUAL storage dtype is the arch's param_dtype (which
         # model_arch may override independently of train.param_dtype)
@@ -341,6 +371,7 @@ class PPOTrainer(BaseRLTrainer):
         def cast_tree(params):
             return compute_dtype_cast(params, cdtype)
 
+        self._rollout_compute_dtype = cdtype
         self._rollout_cast_jit = jax.jit(
             cast_tree,
             in_shardings=(self.param_shardings,),
@@ -355,10 +386,16 @@ class PPOTrainer(BaseRLTrainer):
         )(self.ref_params)
 
     def rollout_params(self):
-        """Params the rollout phase runs on: the compute-dtype copy when the
-        cast is enabled (recast lazily after each train phase — TrainState
-        is replaced on update, so object identity detects staleness), else
-        the f32 masters."""
+        """Params the rollout phase runs on.
+
+        While a streamed phase is active: the frozen behavior snapshot
+        taken at :meth:`begin_streamed_phase` — NOT the live masters,
+        which epoch-1 updates are mutating (and donating) underneath.
+        Otherwise: the compute-dtype copy when the cast is enabled (recast
+        lazily after each train phase — TrainState is replaced on update,
+        so object identity detects staleness), else the f32 masters."""
+        if self._behavior_params is not None:
+            return self._behavior_params
         if self._rollout_cast_jit is None:
             return self.state.params
         master = self.state.params
@@ -774,8 +811,32 @@ class PPOTrainer(BaseRLTrainer):
         method: PPOConfig = self.config.method
         batch_sh = batch_sharding(self.mesh)
         rep = replicated(self.mesh)
+        self._batch_sh = batch_sh
 
         self._rebuild_sampler()
+
+        # Behavior-policy snapshot for the streamed phase: the compute-dtype
+        # cast (when enabled) plus an unconditional per-leaf copy. The copy
+        # matters: pjit forwards pass-through inputs to outputs, so a leaf
+        # the cast leaves untouched (ROLLOUT_CAST_EXCLUDE, or every leaf in
+        # the no-cast path) would ALIAS the master buffer — which the very
+        # first streamed train step donates. The snapshot must own every
+        # buffer it serves to in-flight samplers.
+        cast_active = self._rollout_cast_jit is not None
+        snap_dtype = self._rollout_compute_dtype
+
+        def behavior_snapshot(params):
+            if cast_active:
+                from trlx_tpu.utils import compute_dtype_cast
+
+                params = compute_dtype_cast(params, snap_dtype)
+            return jax.tree_util.tree_map(jnp.copy, params)
+
+        self._behavior_snapshot_jit = jax.jit(
+            behavior_snapshot,
+            in_shardings=(self.param_shardings,),
+            out_shardings=self.param_shardings,
+        )
 
         self._score_ref_jit = jax.jit(
             self._ref_logprobs,
@@ -923,7 +984,7 @@ class PPOTrainer(BaseRLTrainer):
         return rewards
 
     def train_on_buffer(
-        self, seed: int = 0
+        self, seed: int = 0, n_minibatches: Optional[int] = None
     ) -> Tuple[int, Dict[str, Any], List[float]]:
         """One fused buffer pass: every minibatch x ``ppo_epochs`` update in a
         single device dispatch (vs one dispatch per update). Returns
@@ -939,11 +1000,18 @@ class PPOTrainer(BaseRLTrainer):
         """
         train = self.config.train
         method: PPOConfig = self.config.method
+        # n_minibatches (optional) fixes the pass size — learn() passes
+        # its planned per-pass count so a buffer over-collected by a
+        # non-dividing final chunk cannot train more updates than the
+        # step accounting (iter_count / total_steps) assumes
         mbs = self.buffer.stacked_minibatches(
             train.batch_size, shuffle=True, seed=seed,
             sharding=self._stacked_batch_sh, repeat=method.ppo_epochs,
+            n_minibatches=n_minibatches,
         )
         n_mb = len(self.buffer) // train.batch_size
+        if n_minibatches is not None:
+            n_mb = min(n_mb, n_minibatches)
         # the compute-dtype rollout copy is dead weight through the train
         # phase (the memory high-water mark); free it before dispatch —
         # it is recast from the new masters at the next collect anyway
@@ -958,6 +1026,242 @@ class PPOTrainer(BaseRLTrainer):
             )
         self.kl_coef = kl_seq[-1]
         return n_mb * method.ppo_epochs, stats, kl_seq
+
+    # ------------------ streamed collect→train phase ------------------ #
+    #
+    # The phase barrier between `make_experience` and the buffer pass is
+    # broken while preserving EXACT on-policy semantics
+    # (docs/async_pipeline.md):
+    #
+    # 1. `begin_streamed_phase` snapshots the behavior policy once (fresh
+    #    buffers; donation-safe) and fixes the entire update schedule up
+    #    front (`StreamPlan`) from the known rollout total;
+    # 2. the orchestrator calls `on_rollouts_landed` after each chunk
+    #    lands in the streaming buffer; epoch-1 minibatch updates are
+    #    dispatched the moment their constituent rollouts exist — while
+    #    later chunks are still decoding against the frozen snapshot;
+    # 3. `finish_streamed_phase` dispatches any remainder, runs epochs
+    #    2..ppo_epochs as the fused train_phase scan, advances the KL
+    #    controller once per minibatch (it only feeds the NEXT phase),
+    #    and reports overlap attribution stats.
+    #
+    # Every rollout samples from the same frozen snapshot and behavior
+    # logprobs are recorded at decode time, so the overlapped schedule is
+    # semantically identical to running the same plan serially — pinned
+    # bitwise in tests/test_phase_overlap.py.
+
+    def begin_streamed_phase(
+        self,
+        seed: int = 0,
+        num_rollouts: Optional[int] = None,
+        overlap: Optional[bool] = None,
+    ) -> "_StreamedPhase":
+        """Open a streamed phase: snapshot the behavior policy, fix the
+        minibatch plan, and switch the buffer to incremental stream mode.
+        ``overlap=False`` runs the identical schedule serially (every
+        update dispatched in :meth:`finish_streamed_phase`) — the parity
+        baseline."""
+        if self._stream is not None:
+            raise RuntimeError(
+                "a streamed phase is already active; finish_streamed_phase "
+                "(or abort_streamed_phase after an error) before beginning "
+                "another"
+            )
+        method: PPOConfig = self.config.method
+        train = self.config.train
+        total = int(num_rollouts if num_rollouts is not None
+                    else method.num_rollouts)
+        plan = make_stream_plan(
+            total, train.batch_size, method.ppo_epochs, seed
+        )
+        if len(self.buffer):
+            self.buffer.clear_history()
+        self.buffer.begin_stream(plan.total)
+        # the legacy lazy cast copy is dead weight once the snapshot exists
+        self._rollout_params_cache = None
+        self._behavior_params = self._behavior_snapshot_jit(self.state.params)
+        self._stream = _StreamedPhase(
+            plan,
+            overlap=train.phase_overlap if overlap is None else bool(overlap),
+        )
+        return self._stream
+
+    def on_rollouts_landed(self) -> None:
+        """Orchestrator hook, called after each rollout chunk lands in the
+        buffer: dispatch every epoch-1 minibatch whose rows now exist.
+        No-op outside a streamed phase or in serial (parity) mode."""
+        st = self._stream
+        if st is None or not st.overlap:
+            return
+        self._dispatch_ready_minibatches()
+
+    def _dispatch_ready_minibatches(self, force: bool = False) -> None:
+        st = self._stream
+        plan = st.plan
+        landed = len(self.buffer)
+        while st.next_mb < plan.n_minibatches and (
+            force or plan.ready(st.next_mb, landed)
+        ):
+            mb = self.buffer.gather(
+                plan.epoch1[st.next_mb], sharding=self._batch_sh
+            )
+            if st.t_first_dispatch is None:
+                st.t_first_dispatch = time.time()
+            self.state, stats = self._train_step_jit(self.state, mb)
+            st.epoch1_stats.append(stats)
+            st.next_mb += 1
+
+    def finish_streamed_phase(
+        self,
+    ) -> Tuple[int, Dict[str, np.ndarray], List[float]]:
+        """Close the active streamed phase: run everything the plan still
+        owes (all of epoch 1 in serial mode; epochs 2..ppo_epochs always),
+        advance the KL controller, and return ``(n_updates, rows,
+        kl_seq)`` — ``rows`` maps each stats key to an [n_updates] host
+        array in execution order (epoch-major: all epoch-1 updates, then
+        epoch 2, ...)."""
+        st = self._stream
+        if st is None:
+            raise RuntimeError("no streamed phase is active")
+        method: PPOConfig = self.config.method
+        train = self.config.train
+        plan = st.plan
+
+        t_collect_end = time.time()
+        st.dispatched_during_collect = st.next_mb
+        self._dispatch_ready_minibatches(force=True)
+        # Drain: how long the host still waits on epoch-1 device work
+        # after collection ended. A serial schedule pays the WHOLE epoch-1
+        # compute here; overlap pays only the unhidden tail.
+        jax.block_until_ready(st.epoch1_stats[-1])
+        drain_ms = (time.time() - t_collect_end) * 1000.0
+
+        # the snapshot is dead weight for the residual epochs — drop our
+        # reference before the fused dispatch (in-flight consumers keep
+        # the device buffers alive until they complete)
+        self._behavior_params = None
+
+        residual_stats = None
+        residual_ms = 0.0
+        if plan.residual.size:
+            mbs = self.buffer.gather(
+                plan.residual, sharding=self._stacked_batch_sh
+            )
+            t0 = time.time()
+            self.state, residual_stats = self._train_phase_jit(
+                self.state, mbs
+            )
+            jax.block_until_ready(self.state.params)
+            residual_ms = (time.time() - t0) * 1000.0
+
+        # one transfer event for every host consumer of the phase
+        e1_rows, res_rows, mean_kl = jax.device_get(
+            (st.epoch1_stats, residual_stats, self.mean_kl)
+        )
+        rows: Dict[str, np.ndarray] = {}
+        for key in e1_rows[0]:
+            seq = np.stack([np.asarray(r[key]) for r in e1_rows])
+            if res_rows is not None:
+                seq = np.concatenate([seq, np.asarray(res_rows[key])])
+            rows[key] = seq
+
+        # adaptive KL controller: one update per minibatch, compounding as
+        # the stepwise/fused paths do — it only feeds the NEXT collection,
+        # so advancing it after the phase is exact
+        self._last_phase_mean_kl = float(mean_kl)
+        kl_seq = [float(self.kl_coef)]
+        for _ in range(plan.n_minibatches):
+            kl_seq.append(float(kl_controller_update(
+                method, kl_seq[-1], self._last_phase_mean_kl,
+                train.batch_size,
+            )))
+        self.kl_coef = kl_seq[-1]
+
+        # Overlap attribution (exp/overlap_saved_ms). Ground truth is the
+        # interleaved A/B (ab_phase_overlap.py); these stats are the
+        # cheap per-phase estimate: epoch-1 serial cost is taken from the
+        # residual pass (same programs, (ppo_epochs-1) identical epochs)
+        # when available, else bounded by the dispatch window.
+        window_ms = (
+            max(0.0, (t_collect_end - st.t_first_dispatch) * 1000.0)
+            if st.t_first_dispatch is not None
+            else 0.0
+        )
+        if method.ppo_epochs > 1 and residual_ms > 0.0:
+            epoch1_est_ms = residual_ms / (method.ppo_epochs - 1)
+            saved_ms = max(0.0, epoch1_est_ms - drain_ms)
+        else:
+            saved_ms = max(0.0, window_ms - drain_ms)
+        self._last_overlap_stats = {
+            "exp/overlap_saved_ms": saved_ms,
+            "exp/overlap_drain_ms": drain_ms,
+            "exp/overlap_window_ms": window_ms,
+            "exp/overlap_streamed_updates": float(
+                st.dispatched_during_collect
+            ),
+            "exp/phase_residual_ms": residual_ms,
+        }
+
+        self._stream = None
+        return plan.n_updates, rows, kl_seq
+
+    def _stream_eligible(self, iter_count: int) -> bool:
+        """Whether the NEXT collect+train pass can run as a streamed phase:
+        overlap enabled, an orchestrator attached, at least one planned
+        minibatch, no profiler trace wanted, and no eval/checkpoint
+        boundary or total_steps cutoff strictly inside the pass (those
+        fall back to the legacy fused/stepwise paths, which honor
+        mid-pass cadence)."""
+        train = self.config.train
+        method: PPOConfig = self.config.method
+        if not train.phase_overlap or self.orch is None or train.profile_dir:
+            return False
+        n_mb = method.num_rollouts // train.batch_size
+        if n_mb < 1:
+            return False
+        pass_steps = n_mb * method.ppo_epochs
+        total_steps = min(
+            train.total_steps, train.epochs * pass_steps
+        )
+        if iter_count + pass_steps > total_steps:
+            return False
+        # interior MINIBATCH boundaries only — the same set the fused
+        # path's gate checks: no execution path can evaluate/save at a
+        # mid-minibatch step, so an interval multiple landing there must
+        # not disable streaming for the whole run
+        for k in range(1, n_mb):
+            s = iter_count + method.ppo_epochs * k
+            if s % train.eval_interval == 0 or s % train.checkpoint_interval == 0:
+                return False
+        return True
+
+    def abort_streamed_phase(self) -> None:
+        """Error-recovery escape hatch: drop an active streamed phase
+        without running its remaining updates. Clears the plan and the
+        behavior snapshot and empties the buffer (a partial phase's
+        experience cannot satisfy the plan). Epoch-1 updates already
+        dispatched are NOT rolled back — on-policy semantics of the next
+        phase are unaffected since it snapshots afresh."""
+        self._stream = None
+        self._behavior_params = None
+        self.buffer.clear_history()
+
+    def _collect_phase(self, iter_count: int, seed: int) -> None:
+        """Collect one phase of experience — streamed (the default) when
+        the coming pass is eligible, else the plain serial collection the
+        legacy train paths consume. A collection failure aborts the
+        stream so a caller's retry starts from a clean slate instead of
+        wedging on the stale plan."""
+        if self._stream_eligible(iter_count):
+            self.begin_streamed_phase(seed=seed)
+        try:
+            self.orch.make_experience(
+                self.config.method.num_rollouts, iter_count
+            )
+        except BaseException:
+            if self._stream is not None:
+                self.abort_streamed_phase()
+            raise
 
     def learn(self) -> Dict[str, Any]:
         """PPO optimization loop (reference `accelerate_base_model.py:224-305`
@@ -976,10 +1280,21 @@ class PPOTrainer(BaseRLTrainer):
                 self._final_stats = {}
                 return {}
 
+        # the loop's step counter must come from BEFORE any streamed
+        # epoch-1 update advances state.step during the initial collection
+        start_step = int(self.state.step)
         if len(self.buffer) == 0 and self.orch is not None:
-            self.orch.make_experience(method.num_rollouts, 0)
+            self._collect_phase(start_step, seed=train.seed)
 
-        n_minibatches = max(len(self.buffer) // train.batch_size, 1)
+        if self._stream is not None:
+            # streamed phases advance iter_count by the PLAN's update
+            # count; rows a non-dividing final chunk over-collects are
+            # stored but never scheduled, so sizing the loop from
+            # len(buffer) would set a total_steps the phases can never
+            # reach (skipping the end-of-run save + eval)
+            n_minibatches = self._stream.plan.n_minibatches
+        else:
+            n_minibatches = max(len(self.buffer) // train.batch_size, 1)
         total_steps = min(
             train.total_steps, train.epochs * method.ppo_epochs * n_minibatches
         )
@@ -994,7 +1309,7 @@ class PPOTrainer(BaseRLTrainer):
         self.logger = logger
         self._profiling = False
         try:
-            return self._learn_body(logger, total_steps, n_minibatches)
+            return self._learn_body(logger, total_steps, n_minibatches, start_step)
         finally:
             # single epilogue for every exit (incl. exceptions): stop any
             # live profiler trace, join in-flight async checkpoint writes
@@ -1010,19 +1325,62 @@ class PPOTrainer(BaseRLTrainer):
                 finally:
                     logger.finish()
 
+    def _end_of_pass(
+        self,
+        logger: Logger,
+        iter_count: int,
+        total_steps: int,
+        final_stats: Dict[str, Any],
+        epoch: int,
+    ) -> Tuple[Dict[str, Any], bool]:
+        """Shared epilogue of a whole-pass branch (streamed or fused) of
+        ``_learn_body``: interval-gated eval/save at the pass boundary,
+        the end-of-run save + final eval, and the on-policy refresh for
+        the next epoch. Returns ``(final_stats, done)`` — ``done`` means
+        the run is complete and the caller must return."""
+        train = self.config.train
+        iv = self.intervals(iter_count)
+        if iv["do_save"] and iter_count >= total_steps:
+            # the end-of-run branch below saves this same step
+            iv["do_save"] = False
+        if iv["do_eval"]:
+            eval_stats = self.evaluate()
+            logger.log(eval_stats, step=iter_count)
+            final_stats.update(eval_stats)
+        if iv["do_save"]:
+            self.save()
+        if iter_count >= total_steps:
+            self.save()
+            eval_stats = self.evaluate()
+            logger.log(eval_stats, step=iter_count)
+            final_stats.update(eval_stats)
+            self._final_stats = final_stats
+            return final_stats, True
+        if self.orch is not None and epoch < train.epochs - 1:
+            self.buffer.clear_history()
+            self._collect_phase(iter_count, seed=train.seed + epoch + 1)
+        return final_stats, False
+
     def _learn_body(
-        self, logger: Logger, total_steps: int, n_minibatches: int
+        self,
+        logger: Logger,
+        total_steps: int,
+        n_minibatches: int,
+        start_step: int = 0,
     ) -> Dict[str, Any]:
         train = self.config.train
         method: PPOConfig = self.config.method
 
+        # (with a streamed phase active, the sampler serves the frozen
+        # behavior snapshot — this eval reflects the pre-phase policy even
+        # though epoch-1 updates may already be in flight)
         stats = self.evaluate()
         logger.log(stats, step=0)
         if hasattr(self, "_last_samples"):
             logger.log_samples(self._last_samples[1], self._last_samples[0], step=0)
 
         clock = Clock()
-        iter_count = int(self.state.step)  # nonzero after resume
+        iter_count = start_step  # nonzero after resume
         final_stats: Dict[str, Any] = {}
         self._final_stats = final_stats
         if iter_count >= total_steps:
@@ -1032,6 +1390,39 @@ class PPOTrainer(BaseRLTrainer):
             jax.profiler.start_trace(train.profile_dir)
             self._profiling = True
         for epoch in range(train.epochs):
+            # Streamed phase (the default): collection already interleaved
+            # epoch-1 updates against the behavior snapshot; close the
+            # phase (residual epochs + stats) and log per-minibatch
+            # exactly like the fused path.
+            if self._stream is not None:
+                n_up, rows, kl_seq = self.finish_streamed_phase()
+                phase_time = clock.tick(train.batch_size) / 1000.0
+                self.check_anomalies(rows, iter_count)
+                n_mb = n_up // method.ppo_epochs
+                step_stats = {}
+                for k in range(n_mb):
+                    iter_count += method.ppo_epochs
+                    # mb k's FINAL inner update: epoch-major order puts it
+                    # in the last epoch's span (epoch-1 row k when E == 1)
+                    row = (method.ppo_epochs - 1) * n_mb + k
+                    step_stats = {
+                        key: float(v[row]) for key, v in rows.items()
+                    }
+                    step_stats["time/batch"] = phase_time / n_mb
+                    step_stats["policy/kl_coef"] = float(kl_seq[k + 1])
+                    step_stats["policy/mean_rollout_kl"] = (
+                        self._last_phase_mean_kl
+                    )
+                    step_stats.update(self._last_overlap_stats)
+                    if iter_count % train.log_interval == 0:
+                        logger.log(step_stats, step=iter_count)
+                        final_stats = dict(step_stats)
+                final_stats, done = self._end_of_pass(
+                    logger, iter_count, total_steps, final_stats, epoch
+                )
+                if done:
+                    return final_stats
+                continue
             # Fused path: the whole buffer pass is one device dispatch
             # (lax.scan over minibatches) — used whenever no eval/save
             # boundary or total_steps cutoff falls strictly inside the pass
@@ -1052,7 +1443,9 @@ class PPOTrainer(BaseRLTrainer):
                 )
             )
             if fused_ok:
-                _, stacked, kl_seq = self.train_on_buffer(seed=train.seed + epoch)
+                _, stacked, kl_seq = self.train_on_buffer(
+                    seed=train.seed + epoch, n_minibatches=n_minibatches
+                )
                 phase_time = clock.tick(train.batch_size) / 1000.0
                 # one transfer event for the whole stacked stats tree + KL
                 # state (per-key np.asarray would pay ~100ms per leaf on a
@@ -1073,26 +1466,11 @@ class PPOTrainer(BaseRLTrainer):
                     if iter_count % train.log_interval == 0:
                         logger.log(step_stats, step=iter_count)
                         final_stats = dict(step_stats)
-                iv = self.intervals(iter_count)
-                if iv["do_save"] and iter_count >= total_steps:
-                    # the end-of-run branch below saves this same step
-                    iv["do_save"] = False
-                if iv["do_eval"]:
-                    eval_stats = self.evaluate()
-                    logger.log(eval_stats, step=iter_count)
-                    final_stats.update(eval_stats)
-                if iv["do_save"]:
-                    self.save()
-                if iter_count >= total_steps:
-                    self.save()
-                    eval_stats = self.evaluate()
-                    logger.log(eval_stats, step=iter_count)
-                    final_stats.update(eval_stats)
-                    self._final_stats = final_stats
+                final_stats, done = self._end_of_pass(
+                    logger, iter_count, total_steps, final_stats, epoch
+                )
+                if done:
                     return final_stats
-                if self.orch is not None and epoch < train.epochs - 1:
-                    self.buffer.clear_history()
-                    self.orch.make_experience(method.num_rollouts, iter_count)
                 continue
 
             for mb in self.buffer.create_loader(
@@ -1151,7 +1529,7 @@ class PPOTrainer(BaseRLTrainer):
             # `accelerate_ppo_model.py:130-134`)
             if self.orch is not None and epoch < train.epochs - 1:
                 self.buffer.clear_history()
-                self.orch.make_experience(method.num_rollouts, iter_count)
+                self._collect_phase(iter_count, seed=train.seed + epoch + 1)
         self._final_stats = final_stats
         return final_stats
 

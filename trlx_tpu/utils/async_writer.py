@@ -1,0 +1,112 @@
+"""Background JSONL writer: host file I/O off the collect critical path.
+
+``PPOOrchestrator._log_rollouts`` used to append rollout rows to disk
+synchronously inside the collection loop — on a network filesystem a
+single flush can cost tens of milliseconds, sitting squarely on the
+host-side tail the overlapped phase works to hide (docs/async_pipeline.md).
+:class:`BackgroundJSONLWriter` moves the writes to one daemon thread
+behind a BOUNDED queue:
+
+- ``submit(path, rows)`` enqueues one batch of JSON-serializable dicts;
+  it only blocks when the queue is full (backpressure instead of
+  unbounded memory growth when the disk cannot keep up);
+- ``flush()`` waits until everything enqueued so far has hit the
+  filesystem and re-raises the first writer-thread error — callers flush
+  at phase end, so a full phase's rows are durable before the next phase
+  begins, and a failing disk is surfaced at a deterministic point instead
+  of silently dropping rows;
+- the writer is crash-safe: the orchestrator flushes from a ``finally``,
+  so rows already queued are drained to disk even when collection raises.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class BackgroundJSONLWriter:
+    """Append batches of JSON lines to files from a background thread."""
+
+    def __init__(self, maxsize: int = 64):
+        self._q: "queue.Queue[Optional[Tuple[str, List[Dict[str, Any]]]]]" = (
+            queue.Queue(maxsize)
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------ API ------------------------------- #
+
+    def submit(self, path: str, rows: Sequence[Dict[str, Any]]) -> None:
+        """Enqueue ``rows`` for appending to ``path`` (one JSON object per
+        line). Serialization happens here, on the caller, so a
+        non-serializable row fails loudly at the call site rather than
+        asynchronously in the writer thread."""
+        if self._closed:
+            raise RuntimeError("writer is closed")
+        self._raise_pending()
+        lines = [json.dumps(r) for r in rows]
+        self._ensure_thread()
+        self._q.put((path, lines))
+
+    def flush(self, reraise: bool = True) -> None:
+        """Block until every submitted batch has been written; surface the
+        first background error (``reraise=False`` suppresses it — for
+        ``finally`` blocks where another exception is already in
+        flight)."""
+        if self._thread is not None:
+            self._q.join()
+        if reraise:
+            self._raise_pending()
+
+    def close(self, reraise: bool = True) -> None:
+        """Drain, stop the thread, and surface any pending error."""
+        self.flush(reraise=reraise)
+        self._closed = True
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    @property
+    def pending(self) -> int:
+        return self._q.unfinished_tasks
+
+    # ---------------------------- internal ---------------------------- #
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="rollout-jsonl-writer", daemon=True
+                )
+                self._thread.start()
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                "background rollout writer failed; rows after the failure "
+                "may be missing"
+            ) from err
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            path, lines = item
+            try:
+                if self._error is None:
+                    with open(path, "a") as f:
+                        f.write("\n".join(lines) + "\n")
+            except BaseException as e:  # surfaced at the next flush/submit
+                if self._error is None:
+                    self._error = e
+            finally:
+                self._q.task_done()
