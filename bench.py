@@ -520,7 +520,33 @@ def measure_throughput(config, n_phases=5):
         tgbps = n_phases * steps * step_bytes / times["train"] / 1e9
         out["train_phase_hbm_gbps"] = round(tgbps, 1)
         out["train_phase_hbm_util"] = round(tgbps / hbm_peak, 4)
+    out.update(_static_resources(trainer))
     return out
+
+
+def _static_resources(trainer):
+    """Static resource-auditor numbers for the jitted train step at the
+    REAL workload shape (docs/static_analysis.md, engine 6) — tracing
+    only, no compilation. Printed next to the measured stats so every
+    bench run surfaces the same contracts CI gates: peak live HBM per
+    device (donation- and sharding-aware), modeled collective bytes, and
+    counted step FLOPs (an exact-arithmetic cross-check of
+    ``_phase_flops``' closed form)."""
+    try:
+        from trlx_tpu.analysis.resource_audit import trainer_step_resources
+
+        res = trainer_step_resources(trainer)
+        return {
+            "static_train_step_peak_hbm_gb": round(
+                res.peak_hbm_bytes / 2**30, 3
+            ),
+            "static_train_step_collective_mb": round(
+                res.collective_bytes / 2**20, 3
+            ),
+            "static_train_step_gflops": round(res.flops / 1e9, 1),
+        }
+    except Exception as e:  # the measured numbers must still print
+        return {"static_resource_error": f"{type(e).__name__}: {e}"}
 
 
 def main():

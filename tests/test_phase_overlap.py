@@ -495,3 +495,46 @@ def test_background_rollout_writer_drains_and_surfaces_errors(tmp_path):
     with pytest.raises(RuntimeError, match="background rollout writer"):
         w.close()
     w.close(reraise=False)
+
+
+def test_rollout_writer_drain_on_exception_path_surfaces_at_close(tmp_path):
+    # the orchestrator's `finally` drains with reraise=False when another
+    # exception is already propagating; a write error hit during that
+    # final drain must not be swallowed forever — it re-raises at close,
+    # and a RAISING close still stops the writer thread (no leak)
+    import json
+
+    from trlx_tpu.utils.async_writer import BackgroundJSONLWriter
+
+    w = BackgroundJSONLWriter(maxsize=4)
+    good = str(tmp_path / "good.jsonl")
+    w.submit(good, [{"i": 0}])
+    w.submit(str(tmp_path / "no_dir" / "x.jsonl"), [{"i": 1}])
+    w.flush(reraise=False)  # drain-on-exception: queue fully drained ...
+    assert w.pending == 0  # ... and already empty when close runs
+    with pytest.raises(RuntimeError, match="background rollout writer"):
+        w.close()
+    assert w._thread is None  # raising close still shut the thread down
+    with open(good) as f:
+        assert [json.loads(line)["i"] for line in f] == [0]
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit(good, [{"i": 2}])
+
+
+def test_orchestrator_close_closes_rollout_writer(tmp_path):
+    # PPOOrchestrator.close must surface a swallowed writer error at the
+    # end of a run (api.train calls it after learn())
+    from trlx_tpu.orchestrator import Orchestrator
+    from trlx_tpu.orchestrator.ppo_orchestrator import PPOOrchestrator
+    from trlx_tpu.utils.async_writer import BackgroundJSONLWriter
+
+    orch = PPOOrchestrator.__new__(PPOOrchestrator)
+    orch._rollout_writer = BackgroundJSONLWriter(maxsize=4)
+    orch._rollout_writer.submit(str(tmp_path / "no_dir" / "x.jsonl"), [{}])
+    orch._rollout_writer.flush(reraise=False)
+    with pytest.raises(RuntimeError, match="background rollout writer"):
+        orch.close()
+    assert orch._rollout_writer is None
+    orch.close()  # idempotent
+    # the base class close is a safe no-op for writer-less orchestrators
+    Orchestrator.close(orch)

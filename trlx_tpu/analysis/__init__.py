@@ -1,5 +1,6 @@
 """Static analysis for the TPU port: jaxpr audit, AST lint, NaN-source
-dataflow, collective-sequence divergence, and an eqn-level sanitizer.
+dataflow, collective-sequence divergence, an eqn-level sanitizer, a
+resource auditor with CI-gated budgets, and a donation-safety checker.
 
 The engines enforce the invariants the reference kept by convention
 (bf16 compute / f32 optimizer, frozen KL reference, declared-collective
@@ -19,6 +20,13 @@ safety properties the fsdp/tp NaN divergence exposed:
 - :mod:`trlx_tpu.analysis.sanitizer` — ``--sanitize <trainer>`` replays
   a captured step jaxpr eqn-by-eqn on concrete values and reports the
   first non-finite equation with source provenance.
+- :mod:`trlx_tpu.analysis.resource_audit` — ``--resources`` computes
+  static peak-HBM / collective-traffic / FLOP budgets per traced program
+  and gates them against the committed ``analysis/budgets.json``
+  contract (``--update-budgets`` regenerates it).
+- :mod:`trlx_tpu.analysis.donation` — donation-safety: host
+  use-after-donate (AST), donated-but-unreusable buffers, and
+  input-forwarding alias escapes (jaxpr).
 
 Run ``python -m trlx_tpu.analysis --help`` or see docs/static_analysis.md.
 """
@@ -50,7 +58,7 @@ def run(
     """Run the selected engine(s); returns a merged :class:`Report`.
 
     :param engine: ``all`` | ``jaxpr`` | ``ast`` | ``nanflow`` |
-        ``collective``.
+        ``collective`` | ``donation``.
     :param paths: files/dirs for the AST lint (default: the trlx_tpu
         package directory).
     :param trainers: trainer kinds for the trainer-tracing engines
@@ -69,8 +77,8 @@ def run(
         report.extend(findings)
         report.covered += covered
         report.suppressed += suppressed
-    if engine in ("all", "jaxpr", "nanflow"):
-        # one trace of the trainer programs feeds both jaxpr-walking
+    if engine in ("all", "jaxpr", "nanflow", "donation"):
+        # one trace of the trainer programs feeds all jaxpr-walking
         # engines — trainer construction dominates the cost
         from trlx_tpu.analysis import harness
 
@@ -86,6 +94,13 @@ def run(
             from trlx_tpu.analysis.nan_flow import analyze_trainers
 
             sub = analyze_trainers(trainers, programs=programs)
+            report.extend(sub.findings)
+            report.covered += sub.covered
+            report.suppressed += sub.suppressed
+        if engine in ("all", "donation"):
+            from trlx_tpu.analysis.donation import audit_all
+
+            sub = audit_all(trainers, paths=paths, programs=programs)
             report.extend(sub.findings)
             report.covered += sub.covered
             report.suppressed += sub.suppressed

@@ -4,6 +4,14 @@ Exit status: 0 when clean; 1 when findings remain (``--strict`` counts
 warnings too, plain mode only errors). Designed for CI on CPU-only
 runners — the jaxpr audit forces an 8-virtual-device CPU platform before
 JAX initializes so collective/sharding structure is real.
+
+Besides the rule engines there are two report modes: ``--sanitize
+<trainer>`` (eqn-level non-finite replay) and ``--resources`` (static
+peak-HBM / collective / FLOP budgets per traced program, gated against
+the committed ``analysis/budgets.json``; ``--update-budgets``
+regenerates the lockfile). JSON output carries a top-level
+``schema_version`` and deterministic ordering so CI artifacts diff
+cleanly.
 """
 
 from __future__ import annotations
@@ -29,9 +37,29 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--engine",
-        choices=("all", "jaxpr", "ast", "nanflow", "collective"),
+        choices=("all", "jaxpr", "ast", "nanflow", "collective", "donation"),
         default="all",
         help="which engine(s) to run (default: all)",
+    )
+    parser.add_argument(
+        "--resources",
+        action="store_true",
+        help="instead of the rule engines: compute static peak-HBM / "
+        "collective-bytes / FLOP budgets per traced program and gate "
+        "them against the committed analysis/budgets.json contract",
+    )
+    parser.add_argument(
+        "--update-budgets",
+        action="store_true",
+        help="with --resources: regenerate the budget lockfile from the "
+        "current trace instead of checking against it (review the diff!)",
+    )
+    parser.add_argument(
+        "--budgets",
+        metavar="PATH",
+        default=None,
+        help="budget contract file for --resources "
+        "(default: trlx_tpu/analysis/budgets.json)",
     )
     parser.add_argument(
         "--sanitize",
@@ -44,8 +72,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--mesh",
         default=None,
-        help="mesh axis sizes for --sanitize, e.g. dp=2,fsdp=2,tp=2 "
-        "(default: the audit mesh)",
+        help="mesh axis sizes for --sanitize / --resources, e.g. "
+        "dp=2,fsdp=2,tp=2 (default: the audit mesh)",
     )
     parser.add_argument(
         "--plant-nan",
@@ -94,16 +122,54 @@ def main(argv=None) -> int:
                   f"{rule.description}")
         return 0
 
+    mesh = None
+    if args.mesh:
+        mesh = {
+            k.strip(): int(v)
+            for k, v in (kv.split("=") for kv in args.mesh.split(","))
+        }
+    trainers = (
+        [t.strip() for t in args.trainers.split(",") if t.strip()]
+        if args.trainers
+        else None
+    )
+
+    if args.resources:
+        _force_cpu_platform()
+        from trlx_tpu.analysis.resource_audit import (
+            audit_resources,
+            default_budgets_path,
+            format_resources_text,
+        )
+
+        report, resources = audit_resources(
+            kinds=trainers,
+            mesh=mesh,
+            budgets_path=args.budgets,
+            update=args.update_budgets,
+        )
+        if args.json:
+            print(report.to_json())
+        else:
+            print(format_resources_text(resources))
+            if args.update_budgets and not report.findings:
+                print(
+                    f"budgets written to "
+                    f"{args.budgets or default_budgets_path()} — review "
+                    "and commit the diff"
+                )
+            if report.findings:
+                print(report.format_text())
+        if args.update_budgets:
+            # findings here mean the update was REFUSED (mesh-mixing
+            # partial relock) and nothing was written
+            return 1 if report.findings else 0
+        return report.exit_code(strict=args.strict)
+
     if args.sanitize:
         _force_cpu_platform()
         from trlx_tpu.analysis.sanitizer import sanitize_trainer
 
-        mesh = None
-        if args.mesh:
-            mesh = {
-                k.strip(): int(v)
-                for k, v in (kv.split("=") for kv in args.mesh.split(","))
-            }
         result = sanitize_trainer(
             args.sanitize, mesh=mesh, plant=args.plant_nan,
             streamed=args.streamed,
@@ -112,16 +178,11 @@ def main(argv=None) -> int:
         print(report.to_json() if args.json else result.format_text())
         return report.exit_code(strict=args.strict)
 
-    if args.engine in ("all", "jaxpr", "nanflow", "collective"):
+    if args.engine in ("all", "jaxpr", "nanflow", "collective", "donation"):
         _force_cpu_platform()
 
     from trlx_tpu.analysis import run
 
-    trainers = (
-        [t.strip() for t in args.trainers.split(",") if t.strip()]
-        if args.trainers
-        else None
-    )
     report = run(engine=args.engine, paths=args.paths, trainers=trainers)
     print(report.to_json() if args.json else report.format_text())
     return report.exit_code(strict=args.strict)

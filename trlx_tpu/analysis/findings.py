@@ -22,6 +22,13 @@ from typing import Dict, List, Optional, Sequence
 SEVERITY_ERROR = "error"
 SEVERITY_WARNING = "warning"
 
+# version of the JSON findings artifact (`--json` / CI uploads). Bump when
+# the shape changes: 2 added the field itself, deterministic finding/
+# coverage ordering (dict-iteration order used to reorder the artifact
+# between runs, defeating artifact diffs), and the optional `resources`
+# payload of the --resources report.
+JSON_SCHEMA_VERSION = 2
+
 _DIRECTIVE_RE = re.compile(r"#\s*tpu-lint:\s*disable=([\w\-,\s]+)")
 
 
@@ -74,6 +81,8 @@ class Report:
     findings: List[Finding] = field(default_factory=list)
     covered: List[str] = field(default_factory=list)  # traced programs / files
     suppressed: int = 0
+    # --resources payload: per-program ProgramResources.to_dict() rows
+    resources: Optional[List[Dict]] = None
 
     def extend(self, findings: Sequence[Finding]) -> None:
         self.findings.extend(findings)
@@ -86,15 +95,33 @@ class Report:
             return 1 if self.findings else 0
         return 1 if self.errors() else 0
 
-    def to_json(self) -> str:
-        return json.dumps(
-            {
-                "findings": [f.to_dict() for f in self.findings],
-                "covered": self.covered,
-                "suppressed": self.suppressed,
-            },
-            indent=2,
+    def sorted_findings(self) -> List[Finding]:
+        """Deterministic rule-major ordering for the JSON artifact — dict
+        iteration inside the engines reorders findings run-to-run, which
+        breaks artifact diffs in CI."""
+        return sorted(
+            self.findings,
+            key=lambda f: (
+                f.rule,
+                f.file or "",
+                f.line or 0,
+                f.subject or "",
+                f.message,
+            ),
         )
+
+    def to_json(self) -> str:
+        payload = {
+            "schema_version": JSON_SCHEMA_VERSION,
+            "findings": [f.to_dict() for f in self.sorted_findings()],
+            "covered": sorted(self.covered),
+            "suppressed": self.suppressed,
+        }
+        if self.resources is not None:
+            payload["resources"] = sorted(
+                self.resources, key=lambda r: r.get("subject", "")
+            )
+        return json.dumps(payload, indent=2)
 
     def format_text(self) -> str:
         lines = [f.format_text() for f in self.findings]

@@ -22,7 +22,7 @@ single-axis otherwise.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Set
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 TRAINER_KINDS = ("ppo", "ilql", "grpo", "seq2seq")
 
@@ -178,6 +178,66 @@ class TracedProgram:
     # lets value-contract engines (nan_flow) seed facts like "masks are
     # 0/1" and "adam nu is nonnegative" at the program boundary
     input_paths: Optional[List[str]] = None
+    # mesh axis name -> size of the mesh the program was traced on — the
+    # resource auditor's collective cost model needs participant counts
+    mesh_shape: Optional[Dict[str, int]] = None
+    # per-flat-input sharding divisor (total elements / per-device shard
+    # elements, from the trainer's declared in_shardings) — the resource
+    # auditor divides each input's bytes by this to get per-device HBM
+    input_divisors: Optional[List[int]] = None
+    # (file, line) of the traced callable's def — findings with no eqn to
+    # anchor to (donation-ignored, alias-escape) attach here so inline
+    # `# tpu-lint: disable=` directives still work
+    def_site: Optional[Tuple[str, int]] = None
+
+
+def callable_def_site(fn) -> Optional[Tuple[str, int]]:
+    """(file, first line) of the function a jit wrapper wraps."""
+    inner = getattr(fn, "__wrapped__", fn)
+    code = getattr(inner, "__code__", None)
+    if code is None:
+        return None
+    return code.co_filename, code.co_firstlineno
+
+
+def flat_sharding_divisors(arg_trees, sharding_trees) -> List[int]:
+    """Per-flat-leaf sharding divisor, in make_jaxpr flattening order.
+
+    ``sharding_trees`` mirrors ``arg_trees``; an entry of ``None`` (or a
+    leaf without ``shard_shape``) means replicated -> divisor 1. Each
+    divisor is ``total elements / per-device shard elements`` of the
+    matching :class:`~jax.sharding.NamedSharding`.
+    """
+    import math
+
+    import jax
+
+    divisors: List[int] = []
+    for args, shardings in zip(arg_trees, sharding_trees):
+        leaves = jax.tree_util.tree_leaves(args)
+        if shardings is None:
+            divisors += [1] * len(leaves)
+            continue
+        sh_leaves = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda x: hasattr(x, "shard_shape")
+        )
+        if len(sh_leaves) == 1 and len(leaves) > 1:
+            # one sharding for a whole tree (e.g. batch_sharding)
+            sh_leaves = sh_leaves * len(leaves)
+        for leaf, sh in zip(leaves, sh_leaves):
+            shape = tuple(getattr(leaf, "shape", ()))
+            if not hasattr(sh, "shard_shape") or not shape:
+                divisors.append(1)
+                continue
+            try:
+                shard = sh.shard_shape(shape)
+                total = math.prod(shape)
+                per_dev = math.prod(shard)
+                divisors.append(max(1, total // max(1, per_dev)))
+            except Exception:
+                divisors.append(1)
+        divisors += [1] * (len(leaves) - min(len(leaves), len(sh_leaves)))
+    return divisors
 
 
 def flat_input_paths(*trees, prefixes: Optional[Sequence[str]] = None) -> List[str]:
@@ -305,13 +365,19 @@ def concrete_minibatch(trainer, kind: str, seed: int = 0):
     )
 
 
-def trace_trainer(kind: str) -> List[TracedProgram]:
+def trace_trainer(
+    kind: str, mesh: Optional[Dict[str, int]] = None
+) -> List[TracedProgram]:
     """Build one tiny trainer and abstractly trace its jitted programs."""
     import jax
     import jax.numpy as jnp
 
-    trainer = build_trainer(kind)
+    from trlx_tpu.parallel.mesh import batch_sharding
+
+    trainer = build_trainer(kind, mesh)
     axes = set(trainer.mesh.axis_names)
+    mesh_shape = {k: int(v) for k, v in trainer.mesh.shape.items()}
+    batch_sh = batch_sharding(trainer.mesh)
     state_sds = _sds(trainer.state)
     n_state = len(jax.tree_util.tree_leaves(state_sds))
     if kind == "ilql":
@@ -329,6 +395,11 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
             mesh_axes=axes,
             n_donated_state_leaves=n_state,
             input_paths=step_paths,
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                (state_sds, mb), (trainer.state_shardings, batch_sh)
+            ),
+            def_site=callable_def_site(trainer._train_step_jit),
         )
     ]
 
@@ -353,6 +424,19 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
         if kind == "ilql"
         else (_sds(trainer.state.params), prompt, prompt, key)
     )
+    rollout_shardings = (
+        (
+            {
+                "params": trainer.state_shardings.params,
+                "target": trainer.state_shardings.target_q_params,
+            }
+            if kind == "ilql"
+            else trainer.state_shardings.params
+        ),
+        batch_sh,
+        batch_sh,
+        None,
+    )
     programs.append(
         TracedProgram(
             subject=f"{kind}.rollout",
@@ -362,6 +446,11 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
                 *rollout_args,
                 prefixes=("params", "prompt_ids", "prompt_mask", "key"),
             ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                rollout_args, rollout_shardings
+            ),
+            def_site=callable_def_site(trainer._sample_jit),
         )
     )
 
@@ -375,6 +464,8 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
         stacked = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct((2,) + x.shape, x.dtype), mb
         )
+        from trlx_tpu.parallel.mesh import stacked_batch_sharding
+
         programs.append(
             TracedProgram(
                 subject=f"{kind}.train_phase",
@@ -386,6 +477,15 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
                 input_paths=flat_input_paths(
                     state_sds, stacked, prefixes=("state", "batch")
                 ),
+                mesh_shape=mesh_shape,
+                input_divisors=flat_sharding_divisors(
+                    (state_sds, stacked),
+                    (
+                        trainer.state_shardings,
+                        stacked_batch_sharding(trainer.mesh),
+                    ),
+                ),
+                def_site=callable_def_site(trainer._train_phase_jit),
             )
         )
         # the streamed phase's behavior-policy snapshot (compute-dtype
@@ -403,11 +503,19 @@ def trace_trainer(kind: str) -> List[TracedProgram]:
                 input_paths=flat_input_paths(
                     params_sds, prefixes=("params",)
                 ),
+                mesh_shape=mesh_shape,
+                input_divisors=flat_sharding_divisors(
+                    (params_sds,), (trainer.state_shardings.params,)
+                ),
+                def_site=callable_def_site(trainer._behavior_snapshot_jit),
             )
         )
     return programs
 
 
-def trace_all(kinds: Optional[Sequence[str]] = None) -> Iterator[TracedProgram]:
+def trace_all(
+    kinds: Optional[Sequence[str]] = None,
+    mesh: Optional[Dict[str, int]] = None,
+) -> Iterator[TracedProgram]:
     for kind in kinds or TRAINER_KINDS:
-        yield from trace_trainer(kind)
+        yield from trace_trainer(kind, mesh)

@@ -19,6 +19,8 @@ ENGINE_AST = "ast"
 ENGINE_NANFLOW = "nanflow"
 ENGINE_COLLECTIVE = "collective"
 ENGINE_SANITIZER = "sanitizer"
+ENGINE_RESOURCE = "resource"
+ENGINE_DONATION = "donation"
 
 
 @dataclass(frozen=True)
@@ -174,6 +176,70 @@ register_rule(Rule(
     "Replaying the step eqn-by-eqn turns 'PPO diverges on fsdp/tp' "
     "into 'this equation, this source line, this param path minted "
     "the first NaN' — a one-command localization instead of printf.",
+))
+
+# --------------------------- resource-audit rules ------------------------ #
+
+register_rule(Rule(
+    "hbm-over-budget",
+    ENGINE_RESOURCE,
+    "a traced program's statically-computed peak live HBM (per device, "
+    "sharding- and donation-aware) stays within its committed budget in "
+    "analysis/budgets.json (+ tolerance)",
+    SEVERITY_ERROR,
+    "Memory regressions today surface as OOMs on real hardware (LlamaRL "
+    "makes per-component memory budgets a first-class design input). The "
+    "lockfile turns every peak-HBM change into a reviewable diff: grow "
+    "the budget deliberately with --update-budgets, never by accident.",
+))
+register_rule(Rule(
+    "collective-bytes-regression",
+    ENGINE_RESOURCE,
+    "a traced program's modeled collective traffic (bytes moved per "
+    "device across psum/all_gather/ppermute/all_to_all, attributed to "
+    "mesh axes) stays within its committed budget in analysis/budgets.json",
+    SEVERITY_ERROR,
+    "Interconnect bytes are the scaling ceiling for multi-slice RLHF: an "
+    "accidental extra all_gather costs nothing on the CPU test mesh and "
+    "everything on a real slice. Regressions must be explained in the "
+    "budget-lockfile diff.",
+))
+
+# ----------------------------- donation rules ---------------------------- #
+
+register_rule(Rule(
+    "use-after-donate",
+    ENGINE_DONATION,
+    "host code never reads a pytree after passing it to a donating jitted "
+    "step without rebinding the result first",
+    SEVERITY_ERROR,
+    "A donated buffer is freed/aliased by XLA the moment the step is "
+    "dispatched; the host-side reference silently reads garbage (or "
+    "crashes) — the exact hazard class PR 3's snapshot logic hit, caught "
+    "then only by hand-audit.",
+))
+register_rule(Rule(
+    "donation-ignored",
+    ENGINE_DONATION,
+    "every donated input buffer has a same-shape/dtype output that can "
+    "actually reuse it",
+    SEVERITY_WARNING,
+    "A donated buffer XLA cannot reuse (no shape/dtype-matching output) "
+    "is silent memory waste the runtime only warns about on real "
+    "hardware — the donation promise is a lie and peak HBM is higher "
+    "than the step's budget claims.",
+))
+register_rule(Rule(
+    "alias-escape",
+    ENGINE_DONATION,
+    "no traced program returns a non-donated input leaf unchanged — the "
+    "output would alias the caller's buffer instead of owning fresh "
+    "memory",
+    SEVERITY_ERROR,
+    "pjit input-forwarding aliases the returned array onto the input "
+    "buffer; if any later program donates that buffer, every holder of "
+    "the forwarded output reads reused memory (the PR-3 behavior-"
+    "snapshot hazard: copy per leaf, or donate explicitly).",
 ))
 
 # ---------------------------- AST-lint rules ----------------------------- #

@@ -106,7 +106,18 @@ def train(
         # updates overlapping the decode (docs/async_pipeline.md) instead
         # of a plain serial pre-collection here, and a resumed-finished
         # run skips collection entirely.
-        trainer.learn()
+        # stop the background rollout writer when learn() finishes; a
+        # write error the phase-end drain-on-exception flush swallowed
+        # surfaces here — suppressed only when learn() itself is raising
+        # (try/except/else rather than sys.exc_info() in a finally: the
+        # latter also sees an *enclosing caller's* in-flight exception
+        # and would silently drop the error on a successful run)
+        try:
+            trainer.learn()
+        except BaseException:
+            orch.close(reraise=False)
+            raise
+        orch.close()
         return trainer
 
     elif dataset is not None:

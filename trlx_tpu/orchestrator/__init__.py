@@ -50,3 +50,8 @@ class Orchestrator(ABC):
 
     @abstractmethod
     def make_experience(self, num_rollouts: int, iter_count: int = 0): ...
+
+    def close(self, reraise: bool = True) -> None:
+        """Release end-of-run resources (background writers etc.);
+        ``reraise=False`` suppresses their pending errors for callers
+        already propagating an exception. Base: no-op."""

@@ -93,6 +93,15 @@ class PPOOrchestrator(Orchestrator):
 
             self._rollout_writer = BackgroundJSONLWriter()
 
+    def close(self, reraise: bool = True) -> None:
+        """Stop the rollout writer, draining queued rows; a write error a
+        phase-end drain-on-exception flush swallowed re-raises here (the
+        writer would otherwise take the failure to the grave — rows
+        silently missing from a 'successful' run)."""
+        if self._rollout_writer is not None:
+            writer, self._rollout_writer = self._rollout_writer, None
+            writer.close(reraise=reraise)
+
     def _expand_groups(self, batch, meta):
         """Grouped-baseline support (GRPO): when the trainer declares
         ``group_size`` G > 1, repeat each prompt G times *within the chunk*

@@ -64,13 +64,22 @@ class BackgroundJSONLWriter:
             self._raise_pending()
 
     def close(self, reraise: bool = True) -> None:
-        """Drain, stop the thread, and surface any pending error."""
-        self.flush(reraise=reraise)
+        """Drain, stop the thread, and surface any pending error.
+
+        The thread is stopped BEFORE the pending error is re-raised: a
+        raising close must not leak a live writer thread, and an error
+        that an earlier ``flush(reraise=False)`` swallowed (the
+        drain-on-exception path — e.g. a phase-end flush running while
+        another exception was already propagating) still surfaces here
+        instead of dying with the process."""
+        self.flush(reraise=False)
         self._closed = True
         if self._thread is not None:
             self._q.put(None)
             self._thread.join(timeout=10)
             self._thread = None
+        if reraise:
+            self._raise_pending()
 
     @property
     def pending(self) -> int:
