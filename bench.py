@@ -379,6 +379,14 @@ def measure_throughput(config, n_phases=5):
         trainer, pipeline, reward_fn=reward_fn, chunk_size=config.method.chunk_size
     )
 
+    # compile accounting (docs/static_analysis.md, engine 8): the same
+    # monitor the --compile-audit gate uses counts every actual XLA
+    # compile over the bench's phase loop, so a retrace burning wall
+    # clock shows up NEXT TO the throughput number it depressed
+    from trlx_tpu.analysis.compile_audit import CompileMonitor
+
+    monitor = CompileMonitor()
+
     times = {"collect": 0.0, "train": 0.0}
     overlap_saved = {"ms": 0.0, "phases": 0}
     # cost of one forcing fetch = the flat tunnel round trip; subtracted
@@ -428,14 +436,23 @@ def measure_throughput(config, n_phases=5):
                 )
                 overlap_saved["phases"] += 1
 
-    one_phase()  # warmup: compile sampler + fused train phase
-    one_phase()  # second warmup: absorbs any donated-buffer relayout retrace
+    # __exit__ MUST run even when a phase raises: the monitor holds jax's
+    # pxla/dispatch loggers at DEBUG with a handler attached, and a leaked
+    # handler swallows compile logs process-wide (counts stay readable
+    # after exit)
+    monitor.__enter__()
+    try:
+        one_phase()  # warmup: compile sampler + fused train phase
+        one_phase()  # 2nd warmup: absorbs any donated-buffer relayout retrace
+        monitor.mark_steady()  # any compile past here retraced mid-measurement
 
-    start = time.time()
-    for _ in range(n_phases):
-        one_phase(record=True)
-    # the forcing fetches are measurement apparatus, not workload
-    elapsed = time.time() - start - n_phases * fetch_overhead
+        start = time.time()
+        for _ in range(n_phases):
+            one_phase(record=True)
+        # the forcing fetches are measurement apparatus, not workload
+        elapsed = time.time() - start - n_phases * fetch_overhead
+    finally:
+        monitor.__exit__(None, None, None)
 
     n_chips = len(jax.devices())
     samples_per_sec = n_phases * config.method.num_rollouts / elapsed
@@ -521,6 +538,30 @@ def measure_throughput(config, n_phases=5):
         out["train_phase_hbm_gbps"] = round(tgbps, 1)
         out["train_phase_hbm_util"] = round(tgbps / hbm_peak, 4)
     out.update(_static_resources(trainer))
+    # per-callable compile counts + trace/compile wall time over the
+    # whole run (warmups included); steady_compiles > 0 means a program
+    # RETRACED inside the measured window — the throughput above paid
+    # for XLA time and the run deserves a --compile-audit triage. One-off
+    # warmup compiles of eager primitives are folded into a single total
+    # so the phase programs (and anything that compiled twice) stand out.
+    counts = monitor.counts()
+    steady = monitor.counts(steady_only=True)
+    phase_programs = {
+        "sampler", "train_step", "train_phase", "behavior_snapshot",
+    }
+    out["compile_counts"] = {
+        name: n
+        for name, n in sorted(counts.items())
+        if name in phase_programs or n > 1 or steady.get(name)
+    }
+    out["eager_op_compiles"] = sum(
+        n for name, n in counts.items()
+        if name not in out["compile_counts"]
+    )
+    if steady:
+        out["steady_compiles"] = dict(sorted(steady.items()))
+    out["trace_seconds"] = round(monitor.trace_seconds, 1)
+    out["compile_seconds"] = round(monitor.compile_seconds, 1)
     return out
 
 

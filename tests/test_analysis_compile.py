@@ -1,0 +1,882 @@
+"""Golden tests for engines 8-9 (`compile_audit.py`, `key_lineage.py`).
+
+PR-1/2/4 pattern: a seeded-violation fixture + a clean case per rule id
+(small standalone jitted programs — no trainer construction outside the
+``slow`` marker), suppression round-trip for every new rule, the
+compile-count lockfile roundtrip (engine-8 relock preserves engine-7
+entries and vice versa), and jaxpr-drift classification on deliberately
+shape-/weak_type-drifting fixtures.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+# --------------------------- CompileMonitor ------------------------------ #
+
+def test_compile_monitor_counts_real_compiles_not_cache_hits():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.compile_audit import CompileMonitor
+
+    def doubler(x):
+        return x * 2.0
+
+    f = jax.jit(doubler)
+    with CompileMonitor() as monitor:
+        f(jnp.ones((4,)))  # warmup compile
+        monitor.mark_steady()
+        f(jnp.ones((4,)))  # cache hit: must record NOTHING
+        f(jnp.ones((8,)))  # shape change: a real steady-state retrace
+    assert monitor.counts().get("doubler") == 2
+    assert monitor.counts(steady_only=True).get("doubler") == 1
+    # the pristine repeat call contributed no event
+    assert monitor.compile_seconds > 0.0
+
+
+def test_compile_monitor_restores_logger_state():
+    import logging
+
+    from trlx_tpu.analysis.compile_audit import (
+        _JAX_COMPILE_LOGGERS,
+        CompileMonitor,
+    )
+
+    before = [
+        (lg.level, lg.propagate, len(lg.handlers))
+        for lg in map(logging.getLogger, _JAX_COMPILE_LOGGERS)
+    ]
+    with CompileMonitor():
+        for name in _JAX_COMPILE_LOGGERS:
+            assert not logging.getLogger(name).propagate
+    after = [
+        (lg.level, lg.propagate, len(lg.handlers))
+        for lg in map(logging.getLogger, _JAX_COMPILE_LOGGERS)
+    ]
+    assert before == after
+
+
+# ----------------------------- jaxpr drift ------------------------------- #
+
+def test_jaxpr_drift_none_when_identical():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.compile_audit import diff_jaxprs, jaxpr_fingerprint
+
+    f = lambda x: (x * 2.0).sum()
+    j0 = jax.make_jaxpr(f)(jnp.ones((4,)))
+    jk = jax.make_jaxpr(f)(jnp.ones((4,)))
+    assert diff_jaxprs(j0, jk) is None
+    assert jaxpr_fingerprint(j0) == jaxpr_fingerprint(jk)
+
+
+def test_jaxpr_drift_classifies_shape_change():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.compile_audit import diff_jaxprs
+
+    f = lambda x: (x * 2.0).sum()
+    drift = diff_jaxprs(
+        jax.make_jaxpr(f)(jnp.ones((4,))), jax.make_jaxpr(f)(jnp.ones((8,)))
+    )
+    assert drift is not None and drift.cause == "shape"
+    assert "[4]" in drift.before and "[8]" in drift.after
+
+
+def test_jaxpr_drift_classifies_weak_type_change():
+    # the subtlest retrace source: a Python scalar (weak-typed) replacing
+    # a committed f32 — same shape, same dtype, different cache key
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.compile_audit import diff_jaxprs
+
+    f = lambda x: x + 1.0
+    strong = jax.make_jaxpr(f)(jnp.float32(3.0))
+    weak = jax.make_jaxpr(f)(3.0)
+    drift = diff_jaxprs(strong, weak)
+    assert drift is not None and drift.cause == "weak_type"
+    # the weak-typed aval IS the program input: the finding says so
+    # instead of pointing at a numbered equation
+    assert drift.describe().startswith("program input signature diverged")
+
+
+def test_jaxpr_drift_names_inner_eqn_through_jit_wrapper():
+    # a traced `jax.jit` wrapper is a single outer pjit eqn — the diff
+    # must inline the sub-jaxpr so an inner-only change (same input
+    # signature, same eqn count) is detected AND named (regression:
+    # sub-jaxprs were summarized as `<jaxpr:Neqns>`, hashing inner
+    # changes of equal length identically)
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.compile_audit import diff_jaxprs
+
+    def make(op):
+        @jax.jit
+        def step(x):  # same callable name both times: outer pjit
+            return op(x)  # lines match, only the body differs
+
+        return step
+
+    x = jnp.ones((4,), jnp.float32)
+    a = jax.make_jaxpr(make(lambda v: v * 2.0))(x)
+    b = jax.make_jaxpr(make(lambda v: v + 2.0))(x)
+    drift = diff_jaxprs(a, b)
+    assert drift is not None
+    # the divergence names the inner mul/add line, not the outer pjit
+    joined = drift.before + drift.after
+    assert "mul" in joined and "add" in joined
+    assert drift.eqn_index >= 0
+
+
+def test_jaxpr_drift_prefix_structure_change():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.compile_audit import diff_jaxprs
+
+    x = jnp.ones((4,))
+    drift = diff_jaxprs(
+        jax.make_jaxpr(lambda x: x * 2.0)(x),
+        jax.make_jaxpr(lambda x: (x * 2.0).sum())(x),
+    )
+    assert drift is not None and drift.cause == "structure"
+
+
+# ------------------------- unexpected-retrace ----------------------------- #
+
+def _driven(subject="ppo.train_step", steady=1, def_site=None, drift=None):
+    from trlx_tpu.analysis.compile_audit import DrivenProgram
+
+    d = DrivenProgram(
+        subject=subject, log_name="train_step", def_site=def_site
+    )
+    d.compiles = 1 + steady
+    d.steady_compiles = steady
+    d.drift = drift
+    return d
+
+
+def test_unexpected_retrace_finding_carries_drift():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.compile_audit import diff_jaxprs, retrace_findings
+
+    f = lambda x: (x * 2.0).sum()
+    drift = diff_jaxprs(
+        jax.make_jaxpr(f)(jnp.ones((4,))), jax.make_jaxpr(f)(jnp.ones((8,)))
+    )
+    findings = retrace_findings([_driven(steady=2, drift=drift)])
+    assert len(findings) == 1
+    f0 = findings[0]
+    assert f0.rule == "unexpected-retrace" and f0.severity == "error"
+    assert "recompiled 2×" in f0.message
+    assert "jaxpr drift" in f0.message and "[shape]" in f0.message
+
+
+def test_unexpected_retrace_identical_trace_names_cache_key_churn():
+    from trlx_tpu.analysis.compile_audit import retrace_findings
+
+    d = _driven(steady=1)
+    d.trace0_fingerprint = d.tracek_fingerprint = "abcd" * 4
+    findings = retrace_findings([d])
+    assert len(findings) == 1
+    assert "IDENTICAL at step 0 and step k" in findings[0].message
+
+
+def test_no_retrace_finding_when_steady_window_clean():
+    from trlx_tpu.analysis.compile_audit import retrace_findings
+
+    assert retrace_findings([_driven(steady=0)]) == []
+
+
+def test_unexpected_retrace_suppressible_at_def_site(tmp_path):
+    from trlx_tpu.analysis.findings import filter_suppressed
+    from trlx_tpu.analysis.compile_audit import retrace_findings
+
+    mod = tmp_path / "loop.py"
+    mod.write_text(
+        "def train_step(state, mb):  "
+        "# tpu-lint: disable=unexpected-retrace\n"
+        "    return state\n"
+    )
+    findings = retrace_findings(
+        [_driven(steady=1, def_site=(str(mod), 1))]
+    )
+    kept, n_suppressed = filter_suppressed(findings)
+    assert kept == [] and n_suppressed == 1
+
+
+# ----------------------- compile-count-regression ------------------------- #
+
+def _budgets(**programs):
+    return {
+        "compile_budgets": {
+            "mesh": {"dp": 2},
+            "programs": {
+                s: {"compiles": n} for s, n in programs.items()
+            },
+        }
+    }
+
+
+def test_compile_budget_within_contract_is_clean():
+    from trlx_tpu.analysis.compile_audit import check_compile_budgets
+
+    driven = [_driven(steady=0)]
+    driven[0].compiles = 1
+    findings = check_compile_budgets(
+        driven, _budgets(**{"ppo.train_step": 1}), {"dp": 2}
+    )
+    assert findings == []
+
+
+def test_compile_count_regression_fires_past_locked_count():
+    from trlx_tpu.analysis.compile_audit import check_compile_budgets
+
+    findings = check_compile_budgets(
+        [_driven(steady=1)], _budgets(**{"ppo.train_step": 1}), {"dp": 2}
+    )
+    assert [f.rule for f in findings] == ["compile-count-regression"]
+    assert "compiled 2×" in findings[0].message
+    assert "past the committed 1×" in findings[0].message
+
+
+def test_compile_budget_missing_section_entry_mesh_and_stale():
+    from trlx_tpu.analysis.compile_audit import check_compile_budgets
+
+    d = _driven(steady=0)
+    # no compile_budgets section at all
+    (f0,) = check_compile_budgets([d], {}, {"dp": 2})
+    assert "no compile_budgets section" in f0.message
+    # section present, program entry missing (the unmatched ppo.rollout
+    # entry is also reported stale — both sides of the rename diff)
+    findings = check_compile_budgets(
+        [d], _budgets(**{"ppo.rollout": 1}), {"dp": 2}, "budgets.json"
+    )
+    assert any("no committed compile budget" in f.message for f in findings)
+    # mesh mismatch refuses the comparison outright
+    (f2,) = check_compile_budgets(
+        [d], _budgets(**{"ppo.train_step": 2}), {"dp": 4}
+    )
+    assert "not comparable" in f2.message
+    # stale entry of a driven kind is pruned via a warning
+    findings = check_compile_budgets(
+        [d],
+        _budgets(**{"ppo.train_step": 2, "ppo.gone": 1, "ilql.x": 1}),
+        {"dp": 2},
+    )
+    stale = [f for f in findings if "no longer matches" in f.message]
+    assert len(stale) == 1 and stale[0].subject == "ppo.gone"
+    assert stale[0].severity == "warning"
+
+
+def test_compile_count_regression_suppressible_at_def_site(tmp_path):
+    from trlx_tpu.analysis.findings import filter_suppressed
+    from trlx_tpu.analysis.compile_audit import check_compile_budgets
+
+    mod = tmp_path / "loop.py"
+    mod.write_text(
+        "def train_step(state, mb):  "
+        "# tpu-lint: disable=compile-count-regression\n"
+        "    return state\n"
+    )
+    findings = check_compile_budgets(
+        [_driven(steady=1, def_site=(str(mod), 1))],
+        _budgets(**{"ppo.train_step": 1}),
+        {"dp": 2},
+    )
+    kept, n_suppressed = filter_suppressed(findings)
+    assert kept == [] and n_suppressed == 1
+
+
+# ----------------------- compile-budget lockfile -------------------------- #
+
+def test_committed_lockfile_has_both_engine_sections():
+    # engine 7 locks at the top level, engine 8 under compile_budgets —
+    # one file, two contracts, and a relock of either must not wipe the
+    # other (the roundtrip tests below)
+    from trlx_tpu.analysis.resource_audit import (
+        default_budgets_path,
+        load_budgets,
+    )
+
+    budgets = load_budgets(default_budgets_path())
+    assert budgets["programs"], "engine-7 entries missing"
+    section = budgets["compile_budgets"]
+    assert section["programs"], "engine-8 entries missing"
+    for kind in ("ppo", "ilql", "grpo", "seq2seq"):
+        assert any(
+            s.startswith(kind + ".") for s in section["programs"]
+        ), f"no compile budget locked for {kind}"
+    assert all(
+        int(e["compiles"]) >= 1 for e in section["programs"].values()
+    )
+
+
+def _stub_drive(kind, mesh=None, monitor=None, steps=2):
+    d = _driven(subject=f"{kind}.train_step", steady=0)
+    d.compiles = 1
+    return [d], monitor, {"dp": 2}
+
+
+def test_update_budgets_preserves_engine7_entries(tmp_path, monkeypatch):
+    from trlx_tpu.analysis import compile_audit
+
+    path = str(tmp_path / "budgets.json")
+    engine7 = {
+        "schema_version": 1,
+        "mesh": {"dp": 2},
+        "tolerance_pct": 10,
+        "programs": {"ppo.train_step": {"peak_hbm_bytes": 123}},
+    }
+    with open(path, "w") as fh:
+        json.dump(engine7, fh)
+    monkeypatch.setattr(compile_audit, "drive_trainer", _stub_drive)
+    report, _ = compile_audit.audit_compiles(
+        kinds=["ppo"], budgets_path=path, update=True
+    )
+    assert not report.findings
+    with open(path) as fh:
+        merged = json.load(fh)
+    # engine-7's top-level contract survives the engine-8 relock
+    assert merged["programs"] == engine7["programs"]
+    assert merged["tolerance_pct"] == 10
+    assert merged["compile_budgets"]["programs"] == {
+        "ppo.train_step": {"compiles": 1}
+    }
+
+
+def test_update_budgets_partial_merge_keeps_other_kinds(
+    tmp_path, monkeypatch
+):
+    from trlx_tpu.analysis import compile_audit
+
+    path = str(tmp_path / "budgets.json")
+    with open(path, "w") as fh:
+        json.dump(
+            {
+                "compile_budgets": {
+                    "mesh": {"dp": 2},
+                    "programs": {
+                        "ilql.train_step": {"compiles": 3},
+                        "ppo.train_step": {"compiles": 9},
+                    },
+                }
+            },
+            fh,
+        )
+    monkeypatch.setattr(compile_audit, "drive_trainer", _stub_drive)
+    report, _ = compile_audit.audit_compiles(
+        kinds=["ppo"], budgets_path=path, update=True
+    )
+    assert not report.findings
+    with open(path) as fh:
+        programs = json.load(fh)["compile_budgets"]["programs"]
+    # the ppo subset relock replaced ppo's entry, kept ilql's
+    assert programs["ppo.train_step"] == {"compiles": 1}
+    assert programs["ilql.train_step"] == {"compiles": 3}
+
+
+def test_update_budgets_refuses_cross_mesh_partial_relock(
+    tmp_path, monkeypatch
+):
+    from trlx_tpu.analysis import compile_audit
+
+    path = str(tmp_path / "budgets.json")
+    with open(path, "w") as fh:
+        json.dump(
+            {
+                "compile_budgets": {
+                    "mesh": {"dp": 8},
+                    "programs": {"ilql.train_step": {"compiles": 1}},
+                }
+            },
+            fh,
+        )
+    monkeypatch.setattr(compile_audit, "drive_trainer", _stub_drive)
+    report, _ = compile_audit.audit_compiles(
+        kinds=["ppo"], budgets_path=path, update=True
+    )
+    assert len(report.findings) == 1
+    assert "refusing --update-budgets" in report.findings[0].message
+    with open(path) as fh:
+        unchanged = json.load(fh)["compile_budgets"]
+    assert unchanged["mesh"] == {"dp": 8}  # nothing was written
+
+
+# ---------------------------- retrace-risk -------------------------------- #
+
+_RISK_SRC = """
+import jax
+
+class Loop:
+    def step(self, state, batch, stats):
+        n = len(batch)
+        state, _ = self.train_step_jit(state, n)
+        k = stats.item()
+        state, _ = self.train_step_jit(state, k)
+        return state
+
+    def clean(self, state, mb):
+        state, _ = self.train_step_jit(state, mb)
+        return state
+"""
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(src)
+    return str(p)
+
+
+def test_retrace_risk_flags_len_and_item_fed_to_jit(tmp_path):
+    from trlx_tpu.analysis.compile_audit import lint_retrace_risk
+
+    path = _write(tmp_path, "loop.py", _RISK_SRC)
+    findings, covered, _ = lint_retrace_risk([path])
+    assert covered == [path]
+    assert [f.rule for f in findings] == ["retrace-risk"] * 2
+    assert any("len()" in f.message for f in findings)
+    assert any(".item()" in f.message for f in findings)
+    assert all(f.file == path and f.line for f in findings)
+
+
+def test_retrace_risk_clean_on_device_args(tmp_path):
+    from trlx_tpu.analysis.compile_audit import lint_retrace_risk
+
+    path = _write(
+        tmp_path,
+        "loop.py",
+        "class Loop:\n"
+        "    def clean(self, state, mb):\n"
+        "        state, _ = self.train_step_jit(state, mb)\n"
+        "        return state\n",
+    )
+    findings, _, _ = lint_retrace_risk([path])
+    assert findings == []
+
+
+def test_retrace_risk_nonliteral_static_arg(tmp_path):
+    from trlx_tpu.analysis.compile_audit import lint_retrace_risk
+
+    path = _write(
+        tmp_path,
+        "mod.py",
+        "import jax\n"
+        "step = jax.jit(_step, static_argnums=(1,))\n"
+        "def run(state, flags):\n"
+        "    return step(state, flags.mode)\n",
+    )
+    findings, _, _ = lint_retrace_risk([path])
+    assert any(
+        "static arg 1" in f.message and "non-literal" in f.message
+        for f in findings
+    )
+
+
+def test_retrace_risk_traced_closure_over_mutated_global(tmp_path):
+    from trlx_tpu.analysis.compile_audit import lint_retrace_risk
+
+    path = _write(
+        tmp_path,
+        "mod.py",
+        "import jax\n"
+        "SCALE = 2.0\n"
+        "def bump():\n"
+        "    global SCALE\n"
+        "    SCALE = SCALE + 1\n"
+        "@jax.jit\n"
+        "def traced(x):\n"
+        "    return x * SCALE\n",
+    )
+    findings, _, _ = lint_retrace_risk([path])
+    assert any("module global `SCALE`" in f.message for f in findings)
+
+
+def test_retrace_risk_inline_suppression(tmp_path):
+    from trlx_tpu.analysis.compile_audit import lint_retrace_risk
+
+    path = _write(
+        tmp_path,
+        "loop.py",
+        "class Loop:\n"
+        "    def step(self, state, batch):\n"
+        "        state, _ = self.train_step_jit(state, len(batch))"
+        "  # tpu-lint: disable=retrace-risk\n"
+        "        return state\n",
+    )
+    findings, _, n_suppressed = lint_retrace_risk([path])
+    assert findings == [] and n_suppressed == 1
+
+
+# --------------------------- key-reuse (jaxpr) ----------------------------- #
+
+def test_key_reuse_fires_on_double_draw_from_one_key():
+    import jax
+
+    from trlx_tpu.analysis.key_lineage import analyze_key_flow
+
+    def bad(key):
+        a = jax.random.normal(key, (4,))
+        b = jax.random.normal(key, (4,))
+        return a + b
+
+    closed = jax.make_jaxpr(bad)(jax.random.PRNGKey(0))
+    findings = analyze_key_flow(closed, "fixture.bad", ["key"])
+    assert [f.rule for f in findings] == ["key-reuse"]
+    assert "perfectly correlated" in findings[0].message
+
+
+def test_key_reuse_clean_after_split():
+    import jax
+
+    from trlx_tpu.analysis.key_lineage import analyze_key_flow
+
+    def good(key):
+        k1, k2 = jax.random.split(key)
+        return jax.random.normal(k1, (4,)) + jax.random.normal(k2, (4,))
+
+    closed = jax.make_jaxpr(good)(jax.random.PRNGKey(0))
+    assert analyze_key_flow(closed, "fixture.good") == []
+
+
+def test_key_reuse_typed_key_api():
+    # new-style jax.random.key() lineage tracks through key<fry> avals
+    import jax
+
+    from trlx_tpu.analysis.key_lineage import analyze_key_flow
+
+    def bad(key):
+        return jax.random.uniform(key, (2,)) + jax.random.uniform(key, (2,))
+
+    closed = jax.make_jaxpr(bad)(jax.random.key(0))
+    assert [f.rule for f in analyze_key_flow(closed, "s")] == ["key-reuse"]
+
+
+def test_key_reuse_scan_constant_key_repeats_per_iteration():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.key_lineage import analyze_key_flow
+
+    def bad_scan(key, xs):
+        def body(c, x):
+            # key closes over the scan body => loop-invariant const:
+            # the SAME lineage is drawn from every iteration
+            return c + jax.random.normal(key, ()) * x, None
+
+        out, _ = jax.lax.scan(body, 0.0, xs)
+        return out
+
+    closed = jax.make_jaxpr(bad_scan)(
+        jax.random.PRNGKey(0), jnp.ones((4,))
+    )
+    findings = analyze_key_flow(closed, "fixture.scan")
+    assert [f.rule for f in findings] == ["key-reuse"]
+    assert "per scan iteration" in findings[0].message
+
+
+def test_key_reuse_scan_carried_chain_is_clean():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.key_lineage import analyze_key_flow
+
+    def good_scan(key, xs):
+        def body(k, x):
+            k, sub = jax.random.split(k)
+            return k, jax.random.normal(sub, ()) * x
+
+        _, ys = jax.lax.scan(body, key, xs)
+        return ys
+
+    closed = jax.make_jaxpr(good_scan)(
+        jax.random.PRNGKey(0), jnp.ones((4,))
+    )
+    assert analyze_key_flow(closed, "fixture.scan") == []
+
+
+def test_key_reuse_cond_branches_do_not_add_up():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis.key_lineage import analyze_key_flow
+
+    def branchy(pred, key):
+        return jax.lax.cond(
+            pred,
+            lambda k: jax.random.normal(k, (2,)),
+            lambda k: jax.random.uniform(k, (2,)),
+            key,
+        )
+
+    closed = jax.make_jaxpr(branchy)(
+        jnp.array(True), jax.random.PRNGKey(0)
+    )
+    # one draw per exclusive branch = one consumption at runtime
+    assert analyze_key_flow(closed, "fixture.cond") == []
+
+
+# ------------------------ key-discard / host rules ------------------------- #
+
+def test_key_discard_fires_when_persistent_chain_not_rebound(tmp_path):
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    path = _write(
+        tmp_path,
+        "t.py",
+        "import jax\n"
+        "class T:\n"
+        "    def step(self):\n"
+        "        _, key = jax.random.split(self.rng)\n"
+        "        return jax.random.normal(key, (2,))\n",
+    )
+    findings, _, _ = lint_key_chains([path])
+    assert [f.rule for f in findings] == ["key-discard"]
+    assert "does not rebind" in findings[0].message
+
+
+def test_key_discard_clean_when_chain_advances(tmp_path):
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    path = _write(
+        tmp_path,
+        "t.py",
+        "import jax\n"
+        "class T:\n"
+        "    def step(self):\n"
+        "        self.rng, key = jax.random.split(self.rng)\n"
+        "        return jax.random.normal(key, (2,))\n",
+    )
+    findings, _, _ = lint_key_chains([path])
+    assert findings == []
+
+
+def test_key_discard_fires_on_unconsumed_split_result(tmp_path):
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    path = _write(
+        tmp_path,
+        "t.py",
+        "import jax\n"
+        "def f(rng):\n"
+        "    sub = jax.random.split(rng, 4)\n"
+        "    return rng\n",
+    )
+    findings, _, _ = lint_key_chains([path])
+    assert [f.rule for f in findings] == ["key-discard"]
+    assert "never consumed" in findings[0].message
+
+
+def test_key_discard_clean_on_subscript_and_return_reads(tmp_path):
+    # ANY Load-context read consumes a split result — `keys[0]`,
+    # returning the pair, tuple packing — not just call arguments
+    # (regression: these idiomatic spellings were falsely flagged)
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    path = _write(
+        tmp_path,
+        "t.py",
+        "import jax\n"
+        "def by_subscript(rng):\n"
+        "    keys = jax.random.split(rng, 4)\n"
+        "    k0 = keys[0]\n"
+        "    return jax.random.normal(k0, (2,))\n"
+        "def by_return(rng):\n"
+        "    a, b = jax.random.split(rng)\n"
+        "    return a, b\n",
+    )
+    findings, _, _ = lint_key_chains([path])
+    assert [f.rule for f in findings] == []
+
+
+def test_key_reuse_host_double_draw(tmp_path):
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    path = _write(
+        tmp_path,
+        "t.py",
+        "import jax\n"
+        "def f(key):\n"
+        "    a = jax.random.normal(key, (2,))\n"
+        "    b = jax.random.normal(key, (2,))\n"
+        "    return a + b\n",
+    )
+    findings, _, _ = lint_key_chains([path])
+    assert [f.rule for f in findings] == ["key-reuse"]
+
+
+def test_key_host_rules_inline_suppression(tmp_path):
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    path = _write(
+        tmp_path,
+        "t.py",
+        "import jax\n"
+        "class T:\n"
+        "    def step(self):\n"
+        "        _, key = jax.random.split(self.rng)"
+        "  # tpu-lint: disable=key-discard\n"
+        "        a = jax.random.normal(key, (2,))\n"
+        "        b = jax.random.normal(key, (2,))"
+        "  # tpu-lint: disable=key-reuse\n"
+        "        return a + b\n",
+    )
+    findings, _, n_suppressed = lint_key_chains([path])
+    assert findings == [] and n_suppressed == 2
+
+
+# ------------------------------ fixed-seed -------------------------------- #
+
+def test_fixed_seed_fires_in_training_path(tmp_path):
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    d = tmp_path / "trainer"
+    d.mkdir()
+    path = _write(
+        d,
+        "mod.py",
+        "import jax\n"
+        "def make_rng():\n"
+        "    key = jax.random.PRNGKey(42)\n"
+        "    return key\n",
+    )
+    findings, _, _ = lint_key_chains([path])
+    assert [f.rule for f in findings] == ["fixed-seed"]
+    assert "literal seed 42" in findings[0].message
+
+
+def test_fixed_seed_ignores_non_training_paths_and_config_seed(tmp_path):
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    # a literal seed OUTSIDE the training path (tests, tools) is fine
+    outside = _write(
+        tmp_path, "helper.py",
+        "import jax\nkey = jax.random.PRNGKey(0)\n",
+    )
+    d = tmp_path / "trainer"
+    d.mkdir()
+    config_seed = _write(
+        d, "mod.py",
+        "import jax\n"
+        "def make_rng(config):\n"
+        "    return jax.random.PRNGKey(config.train.seed)\n",
+    )
+    findings, _, _ = lint_key_chains([outside, config_seed])
+    assert findings == []
+
+
+def test_fixed_seed_inline_suppression(tmp_path):
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    d = tmp_path / "pipeline"
+    d.mkdir()
+    path = _write(
+        d,
+        "mod.py",
+        "import jax\n"
+        "key = jax.random.PRNGKey(7)  # tpu-lint: disable=fixed-seed\n",
+    )
+    findings, _, n_suppressed = lint_key_chains([path])
+    assert findings == [] and n_suppressed == 1
+
+
+# ------------------------------ registry ---------------------------------- #
+
+def test_new_rules_registered_with_engines():
+    from trlx_tpu.analysis.registry import get_rule
+
+    for rule_id, engine, severity in [
+        ("unexpected-retrace", "compile", "error"),
+        ("compile-count-regression", "compile", "error"),
+        ("retrace-risk", "compile", "warning"),
+        ("key-reuse", "prng", "error"),
+        ("key-discard", "prng", "warning"),
+        ("fixed-seed", "prng", "warning"),
+    ]:
+        rule = get_rule(rule_id)
+        assert rule.engine == engine and rule.severity == severity
+
+
+def test_list_rules_cli_names_every_new_rule():
+    out = subprocess.run(
+        [sys.executable, "-m", "trlx_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0
+    for rule_id in (
+        "unexpected-retrace", "compile-count-regression", "retrace-risk",
+        "key-reuse", "key-discard", "fixed-seed",
+    ):
+        assert rule_id in out.stdout
+
+
+# --------------------------- repo-level checks ----------------------------- #
+
+def test_retrace_risk_and_prng_host_clean_on_repo():
+    # the AST halves of both engines must be clean on the shipped tree
+    # (the traced halves ride the slow CLI test below / the CI job)
+    from trlx_tpu.analysis.compile_audit import lint_retrace_risk
+    from trlx_tpu.analysis.key_lineage import lint_key_chains
+
+    pkg = os.path.join(REPO, "trlx_tpu")
+    findings, covered, _ = lint_retrace_risk([pkg])
+    assert findings == [], [f"{f.file}:{f.line} {f.message}" for f in findings]
+    assert len(covered) > 20
+    findings, covered, _ = lint_key_chains([pkg])
+    assert findings == [], [f"{f.file}:{f.line} {f.message}" for f in findings]
+    assert len(covered) > 20
+
+
+@pytest.mark.slow
+def test_compile_audit_cli_strict_clean_and_budget_trip(tmp_path):
+    # the acceptance-criteria run: strict audit against the committed
+    # lockfile exits 0; shrinking a locked count trips the gate
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "trlx_tpu.analysis",
+            "--compile-audit", "--trainers", "ilql", "--strict", "--json",
+        ],
+        capture_output=True, text=True, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["schema_version"] == 2
+    assert any(
+        row["subject"] == "ilql.train_step" for row in payload["resources"]
+    )
+
+    # seeded regression: relock ilql's budget to 0 compiles in a copy
+    from trlx_tpu.analysis.resource_audit import (
+        default_budgets_path,
+        load_budgets,
+    )
+
+    budgets = load_budgets(default_budgets_path())
+    for entry in budgets["compile_budgets"]["programs"].values():
+        entry["compiles"] = 0
+    trip = tmp_path / "budgets.json"
+    trip.write_text(json.dumps(budgets))
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "trlx_tpu.analysis",
+            "--compile-audit", "--trainers", "ilql",
+            "--budgets", str(trip), "--strict",
+        ],
+        capture_output=True, text=True, cwd=REPO, env=env,
+    )
+    assert out.returncode == 1
+    assert "compile-count-regression" in out.stdout

@@ -43,22 +43,35 @@ FAMILY_ARCHS = {
 
 
 
-# jaxlib 0.4.36's XLA SPMD partitioner MISCOMPILES the jitted pp
-# model-stage program: `pp_hidden_forward` matches the plain backbone
-# exactly when called eagerly (max diff ~1e-6), and the generic
-# `parallel/pipeline.py` schedules pass their own jit parity tests, but
-# the same model program wrapped in `jax.jit` produces wrong values
-# (100% of elements, max diff ~3) on this jaxlib. Upstream compiler bug
-# in the same family as the sharded-concat replica-sum (see
-# data/ppo_types.py::concat_rollouts); tracked in ROADMAP Open items.
-# run=False: an expected-fail that still executes would burn ~20 s of
-# compile per test inside the 870 s tier-1 budget.
+# jaxlib 0.4.36's XLA SPMD partitioner MISCOMPILES a jitted
+# stack/concatenate whose output feeds a shard_map P("pp") in_spec on any
+# mesh with a second size>1 axis — minimal repro + workaround A/B in
+# tools/pp_miscompile_repro.py. The TRAIN-path trigger (stage-param
+# stacking) is worked around in-tree (`parallel/pipeline.py::spmd_stack`
+# builds [S]-leading stacks from dynamic_update_slice writes), which
+# un-quarantined the train/forward parity tests below. The DECODE path
+# still miscompiles on this jaxlib even with the workaround (wrong
+# sampled tokens vs the plain-mesh sampler — a different member of the
+# same compiler-bug family); those tests stay quarantined. run=False: an
+# expected-fail that still executes would burn ~20 s of compile per test
+# inside the 870 s tier-1 budget. Re-run with --runxfail after a jaxlib
+# bump (ROADMAP Open items).
 PP_JIT_MISCOMPILE = pytest.mark.xfail(
     run=False,
-    reason="jaxlib 0.4.36 XLA SPMD miscompiles the jitted pp model-stage "
-    "program (eager is exact; pipeline primitives pass parity) — ROADMAP "
-    "Open items",
+    reason="jaxlib 0.4.36 XLA SPMD miscompiles the pp cached-decode "
+    "program (train path fixed by spmd_stack; see "
+    "tools/pp_miscompile_repro.py) — ROADMAP Open items",
 )
+
+# the un-quarantined parity tests ride the nightly tier: each is
+# ~20-40 s of compile and tier-1 sits within ~25 s of its 870 s budget
+# (ROADMAP); the spmd_stack-fixed train path keeps tier-1 coverage via
+# test_e2e_ppo_trains_on_dp_fsdp_pp_mesh + the generic
+# test_pipeline_parallel.py schedule-parity tests
+PP_FAMILIES_TIERED = [
+    pytest.param(ft, marks=pytest.mark.slow)
+    for ft in ("gpt2", "gptj", "gpt_neo", "gpt_neox")
+]
 
 def _config(mesh, arch=None, model_type="gpt2", **train_overrides):
     from trlx_tpu.data.configs import TRLConfig
@@ -106,8 +119,7 @@ def _config(mesh, arch=None, model_type="gpt2", **train_overrides):
     )
 
 
-@pytest.mark.parametrize("model_type", list(FAMILY_ARCHS))
-@PP_JIT_MISCOMPILE
+@pytest.mark.parametrize("model_type", PP_FAMILIES_TIERED)
 def test_pp_forward_and_grads_match_plain(model_type):
     """pp_response_forward == response_forward (same params), including
     gradients through the pipeline schedule — for EVERY causal family
@@ -220,8 +232,7 @@ def test_e2e_ppo_trains_on_dp_fsdp_pp_mesh(virtual):
     assert late > early + 0.15, (early, late, means)
 
 
-@pytest.mark.parametrize("model_type", list(FAMILY_ARCHS))
-@PP_JIT_MISCOMPILE
+@pytest.mark.parametrize("model_type", PP_FAMILIES_TIERED)
 def test_pp_interleaved_schedule_matches_and_shrinks_bubble(model_type):
     """Round-3: `train.pp_virtual_stages` runs the interleaved schedule —
     each pp device holds v round-robin layer chunks, fill/drain bubble
@@ -404,7 +415,7 @@ def test_ilql_pp_decode_and_training():
     assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
 
 
-@PP_JIT_MISCOMPILE
+@pytest.mark.slow  # un-quarantined parity, nightly tier (see PP_FAMILIES_TIERED note)
 def test_hydra_under_pp_matches_plain_hydra():
     """Round-3: the hydra shared-trunk KL reference works under pp when the
     branch point sits on a stage boundary — the branch activation is
@@ -508,7 +519,7 @@ def _t5_config(mesh, **train_overrides):
     )
 
 
-@PP_JIT_MISCOMPILE
+@pytest.mark.slow  # un-quarantined parity, nightly tier (see PP_FAMILIES_TIERED note)
 def test_seq2seq_pp_forward_matches_and_trains():
     """Round-3: the seq2seq (T5) PPO path accepts a pp mesh — BOTH trunk
     stacks pipeline in the update's forwards (`pp_runner.pp_t5_forward`,
@@ -591,7 +602,7 @@ def test_seq2seq_pp_forward_matches_and_trains():
     assert all(bool(np.isfinite(np.asarray(l)).all()) for l in leaves)
 
 
-@PP_JIT_MISCOMPILE
+@pytest.mark.slow  # two interleaved schedules per pass: heaviest pp compile
 def test_seq2seq_interleaved_schedule_matches_and_trains():
     """Round-4 (VERDICT r3 #7): `train.pp_virtual_stages` now covers the
     seq2seq stacks — BOTH the encoder and decoder run the interleaved
@@ -745,6 +756,8 @@ def test_seq2seq_pp_decode_matches_plain_sampler():
     )
 
 
+@pytest.mark.slow  # 63 s, heaviest single compile in the suite; the remat
+# backward keeps a tier-1 canary via the nonfloat-leaves variant below
 def test_pp_remat_matches_and_trains():
     """Round-4 (VERDICT r3 #7, the memory half of 1F1B): `train.pp_remat`
     routes the update's trunk through the rematerialized-backward schedule

@@ -111,6 +111,7 @@ def test_resume_with_masked_and_bf16_moment_opt_state(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # nightly tier (ROADMAP tier-1 budget, PR 5 retrim)
 def test_restore_into_changed_opt_layout_raises_actionable_error(tmp_path):
     """ADVICE r4 (low): a checkpoint written under one optimizer-state
     layout (here: full-size moments, no freezing) must not die deep inside
@@ -126,6 +127,7 @@ def test_restore_into_changed_opt_layout_raises_actionable_error(tmp_path):
                        num_layers_unfrozen=2, **kw))
 
 
+@pytest.mark.slow  # nightly tier (ROADMAP tier-1 budget, PR 5 retrim)
 def test_ilql_api_default_eval_prompts_from_token_samples(tmp_path):
     """The offline API path derives eval prompts from (tokens, action_start)
     samples' prompt portions instead of feeding raw tuples to the prompt
@@ -292,6 +294,9 @@ def test_resume_across_changed_mesh_topology(tmp_path):
     assert np.abs(cur_flat - ref_flat).max() < 0.1, "params look re-initialized"
 
 
+@pytest.mark.slow  # nightly tier (ROADMAP tier-1 budget, PR 5 retrim);
+# test_resume_across_changed_mesh_topology keeps the tier-1 canary for
+# the PR-2 sharded-concat fix on resume paths
 def test_resume_pp_checkpoint_on_non_pp_mesh(tmp_path):
     """Topology-change resume across SCHEDULES, not just shardings: a
     checkpoint saved by a pp=2 pipeline-parallel trainer restores exactly

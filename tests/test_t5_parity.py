@@ -41,6 +41,9 @@ def _convert(hf_config, model):
     return config, params
 
 
+@pytest.mark.slow  # ~100 s/param, heaviest compile in the suite (ROADMAP
+# tier-1 budget); t5 keeps tier-1 parity coverage via the cached-decode
+# and sampler-logprob tests below
 @pytest.mark.parametrize(
     "ff,tie", [("relu", True), ("gated-gelu", False)]
 )
@@ -130,6 +133,8 @@ def test_t5_cached_decode_matches_full():
         )
 
 
+@pytest.mark.slow  # nightly tier (ROADMAP tier-1 budget, PR 5 retrim);
+# test_t5_cached_decode_matches_full keeps the tier-1 t5 parity canary
 def test_seq2seq_sampler_logprobs_match_teacher_forcing():
     """The compiled seq2seq sampler's emitted logprobs/values equal the
     teacher-forced recompute on shift_right(response) — the PPO alignment

@@ -1,0 +1,193 @@
+"""Standalone repro of the XLA SPMD pp stage-stacking miscompile.
+
+jaxlib 0.4.36's SPMD partitioner miscompiles the jitted pipeline-parallel
+model-stage program when the per-stage parameter stack is built with
+``jnp.stack`` (lowered to ``concatenate``) and fed to a ``shard_map``
+with a ``P("pp")`` in_spec on any mesh with a second size>1 axis (dp,
+fsdp, or tp — all confirmed): the stages read wrong slices of the
+stacked operand, producing O(1)-wrong activations on ~100% of elements
+(max diff ~3 at the tiny-GPT-2 shape below). Eager execution of the
+*identical* program is exact (~1e-6), and the generic pipeline schedule
+primitives pass their own jit parity tests — the trigger needs the real
+transformer stage body. Same compiler-bug family as the sharded-concat
+replica-sum documented at ``trlx_tpu/data/ppo_types.py::concat_rollouts``
+(PR 2).
+
+Workaround shipped in-tree: ``trlx_tpu/parallel/pipeline.py::spmd_stack``
+builds the [S]-leading stacks from ``dynamic_update_slice`` writes into a
+zeros buffer instead of ``concatenate``; every stage-stacking path
+(``stack_stage_params``, ``_stack_stages``, the interleaved variant) goes
+through it, which flips the quarantined ``test_pp_integration.py`` train
+parity tests from fail to pass.
+
+Run::
+
+    python tools/pp_miscompile_repro.py            # A/B both stackings
+    python tools/pp_miscompile_repro.py --broken   # only the jnp.stack lowering
+
+Expected output on jaxlib 0.4.36 (8 virtual CPU devices)::
+
+    spmd_stack (workaround)  fwd max|diff| 0.000e+00  grad max|diff| 2.4e-07   OK
+    jnp.stack  (broken)      fwd max|diff| 2.987e+00  grad max|diff| 1.0e+00   MISCOMPILED
+
+Exit status: 0 when the workaround variant is exact (the repro is
+*informational* for the broken variant — a newer jaxlib that fixes the
+bug prints ``FIXED UPSTREAM`` and this file + the ROADMAP entry can be
+retired); 1 if the workaround itself diverges.
+
+Minimization notes (for the upstream report): the trigger is NOT
+reproducible with a plain matmul stage — a ``shard_map(P("pp"), ...)``
+over a ``jnp.stack`` of host or committed-fsdp-sharded weights, with or
+without the full ``fori_loop`` + ``ppermute`` + masked-write pipeline
+schedule around it, compiles correctly on this jaxlib. The smallest
+known trigger is the real flax transformer Block as the stage body
+(attention + MLP under ``remat``-free apply), i.e. exactly what
+``pp_response_forward`` runs; the A/B below therefore drives the repo's
+own stage path at the smallest shape that shows the bug. Decode is hit
+separately: the cached-decode path still miscompiles even with
+``spmd_stack`` (wrong sampled tokens on the pp mesh — see the quarantined
+decode tests and the ROADMAP entry), so the sampler keeps its own
+``dynamic_update_slice`` concat workarounds (``ops/sampling.py``) and the
+decode tests stay quarantined until a jaxlib bump fixes both.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("WANDB_DISABLED", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ARCH = {
+    "vocab_size": 16, "n_positions": 16, "n_embd": 32,
+    "n_layer": 4, "n_head": 2,
+}
+MESH = {"dp": -1, "fsdp": 1, "tp": 1, "pp": 2}
+
+
+def _build_trainer():
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = TRLConfig.from_dict({
+        "model": {"model_type": "gpt2", "model_arch": dict(ARCH)},
+        "train": {
+            "seq_length": 4, "batch_size": 16, "epochs": 2,
+            "total_steps": 8, "eval_interval": 1000,
+            "checkpoint_interval": 100000,
+            "lr_init": 1e-3, "lr_target": 1e-3,
+            "mesh": dict(MESH), "dtype": "float32", "seed": 7,
+        },
+        "method": {
+            "name": "PPOConfig", "num_rollouts": 32, "chunk_size": 32,
+            "ppo_epochs": 2, "init_kl_coef": 0.001, "scale_reward": None,
+            "gen_kwargs": {
+                "max_new_tokens": 6, "min_new_tokens": 6, "top_k": 0,
+                "do_sample": True, "eos_token_id": 14, "pad_token_id": 15,
+            },
+        },
+    })
+    trainer = get_trainer("PPOTrainer")(config, reward_fn=lambda **kw: [0.0])
+    return config, trainer
+
+
+def run_variant(use_jnp_stack: bool):
+    """Forward+grad jit parity of pp_response_forward vs the plain
+    backbone, with the stage stacking swapped to the requested lowering.
+    The swap MUST precede trainer construction — the stacking runs when
+    the pp runner first materializes stage params."""
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+    import numpy as np
+
+    import trlx_tpu.models.pp_runner as runner
+    import trlx_tpu.parallel.pipeline as plib
+
+    orig = plib.spmd_stack
+    if use_jnp_stack:
+        broken = lambda *xs: jnp.stack(xs, axis=0)  # noqa: E731
+        plib.spmd_stack = broken
+        runner.spmd_stack = broken
+    try:
+        config, trainer = _build_trainer()
+        params = jax.device_get(trainer.state.params)
+        rng = np.random.default_rng(0)
+        B, Q, R = 16, 4, 6
+        full_ids = jnp.asarray(rng.integers(1, 13, (B, Q + R)), jnp.int32)
+        full_mask = jnp.ones((B, Q + R), jnp.int32)
+
+        from trlx_tpu.models.pp_runner import pp_response_forward
+
+        def pp_path(p):
+            return pp_response_forward(
+                trainer.model_config, p, full_ids, full_mask, Q,
+                trainer.mesh, config.train.pp_microbatches,
+            )
+
+        def plain_path(p):
+            return trainer.model.apply(
+                {"params": p}, full_ids, full_mask, Q,
+                method=trainer.model.response_forward,
+            )
+
+        pl_logits, _ = jax.jit(plain_path)(params)
+        pp_logits, _ = jax.jit(pp_path)(params)
+        fwd = float(jnp.max(jnp.abs(pp_logits - pl_logits)))
+
+        def loss(path):
+            def f(p):
+                logits, values = path(p)
+                return jnp.mean(logits**2) + jnp.mean(values**2)
+            return f
+
+        g_pp = jax.jit(jax.grad(loss(pp_path)))(params)
+        g_pl = jax.jit(jax.grad(loss(plain_path)))(params)
+        f_pp, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_pp))
+        f_pl, _ = jax.flatten_util.ravel_pytree(jax.device_get(g_pl))
+        grad = float(np.max(np.abs(np.asarray(f_pp) - np.asarray(f_pl))))
+        return fwd, grad
+    finally:
+        plib.spmd_stack = orig
+        runner.spmd_stack = orig
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--broken", action="store_true",
+        help="run only the jnp.stack lowering (the miscompile)",
+    )
+    args = parser.parse_args()
+
+    import jax
+
+    print(f"jax {jax.__version__}, {len(jax.devices())} devices, mesh {MESH}")
+    tol = 1e-4
+    status = 0
+    variants = [(True, "jnp.stack  (broken)")] if args.broken else [
+        (False, "spmd_stack (workaround)"),
+        (True, "jnp.stack  (broken)"),
+    ]
+    for use_stack, label in variants:
+        fwd, grad = run_variant(use_stack)
+        bad = fwd > tol or grad > 1e-3
+        if use_stack:
+            verdict = "MISCOMPILED (bug still present)" if bad else (
+                "FIXED UPSTREAM — retire this repro + the ROADMAP entry"
+            )
+        else:
+            verdict = "OK" if not bad else "WORKAROUND BROKEN"
+            status |= int(bad)
+        print(
+            f"{label}  fwd max|diff| {fwd:.3e}  grad max|diff| {grad:.1e}"
+            f"   {verdict}"
+        )
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,15 @@ safety properties the fsdp/tp NaN divergence exposed:
 - :mod:`trlx_tpu.analysis.donation` — donation-safety: host
   use-after-donate (AST), donated-but-unreusable buffers, and
   input-forwarding alias escapes (jaxpr).
+- :mod:`trlx_tpu.analysis.compile_audit` — ``--compile-audit`` runs each
+  trainer's canonical loop under a compilation hook, gates per-callable
+  compile counts against the ``compile_budgets`` lockfile section, and
+  diffs step-0 vs step-k jaxprs so a retrace finding names its cause;
+  its AST retrace-risk rules also run in ``--engine all``.
+- :mod:`trlx_tpu.analysis.key_lineage` — PRNG discipline: key-reuse
+  dataflow over traced jaxprs plus a host-side split-chain walk of
+  ``self.rng`` rebinding (rules ``key-reuse``/``key-discard``/
+  ``fixed-seed``).
 
 Run ``python -m trlx_tpu.analysis --help`` or see docs/static_analysis.md.
 """
@@ -58,7 +67,9 @@ def run(
     """Run the selected engine(s); returns a merged :class:`Report`.
 
     :param engine: ``all`` | ``jaxpr`` | ``ast`` | ``nanflow`` |
-        ``collective`` | ``donation``.
+        ``collective`` | ``donation`` | ``compile`` (AST retrace-risk
+        rules only — the runtime trace-count harness is
+        ``--compile-audit``) | ``prng``.
     :param paths: files/dirs for the AST lint (default: the trlx_tpu
         package directory).
     :param trainers: trainer kinds for the trainer-tracing engines
@@ -77,7 +88,19 @@ def run(
         report.extend(findings)
         report.covered += covered
         report.suppressed += suppressed
-    if engine in ("all", "jaxpr", "nanflow", "donation"):
+    if engine in ("all", "compile"):
+        from trlx_tpu.analysis.compile_audit import lint_retrace_risk
+
+        default_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        findings, covered, suppressed = lint_retrace_risk(
+            paths or [default_root]
+        )
+        report.extend(findings)
+        report.covered.append(f"retrace-risk:{len(covered)} files")
+        report.suppressed += suppressed
+    if engine in ("all", "jaxpr", "nanflow", "donation", "prng"):
         # one trace of the trainer programs feeds all jaxpr-walking
         # engines — trainer construction dominates the cost
         from trlx_tpu.analysis import harness
@@ -101,6 +124,15 @@ def run(
             from trlx_tpu.analysis.donation import audit_all
 
             sub = audit_all(trainers, paths=paths, programs=programs)
+            report.extend(sub.findings)
+            report.covered += sub.covered
+            report.suppressed += sub.suppressed
+        if engine in ("all", "prng"):
+            from trlx_tpu.analysis.key_lineage import (
+                analyze_trainers as analyze_keys,
+            )
+
+            sub = analyze_keys(trainers, paths=paths, programs=programs)
             report.extend(sub.findings)
             report.covered += sub.covered
             report.suppressed += sub.suppressed

@@ -37,9 +37,24 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--engine",
-        choices=("all", "jaxpr", "ast", "nanflow", "collective", "donation"),
+        choices=(
+            "all", "jaxpr", "ast", "nanflow", "collective", "donation",
+            "compile", "prng",
+        ),
         default="all",
-        help="which engine(s) to run (default: all)",
+        help="which engine(s) to run (default: all; `compile` here is "
+        "the static retrace-risk rules — the runtime trace-count "
+        "harness is --compile-audit)",
+    )
+    parser.add_argument(
+        "--compile-audit",
+        action="store_true",
+        help="instead of the rule engines: run each trainer's canonical "
+        "short loop with a compilation hook, attribute every XLA "
+        "compile to its jitted callable, gate counts against the "
+        "compile_budgets section of analysis/budgets.json, and diff "
+        "step-0 vs step-k jaxprs on any steady-state retrace "
+        "(--update-budgets relocks the counts)",
     )
     parser.add_argument(
         "--resources",
@@ -51,8 +66,10 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--update-budgets",
         action="store_true",
-        help="with --resources: regenerate the budget lockfile from the "
-        "current trace instead of checking against it (review the diff!)",
+        help="with --resources / --compile-audit: regenerate that "
+        "engine's section of the budget lockfile from the current run "
+        "instead of checking against it (review the diff!); each "
+        "engine's relock preserves the other's entries",
     )
     parser.add_argument(
         "--budgets",
@@ -134,6 +151,37 @@ def main(argv=None) -> int:
         else None
     )
 
+    if args.compile_audit:
+        _force_cpu_platform()
+        from trlx_tpu.analysis.compile_audit import (
+            audit_compiles,
+            format_compile_text,
+        )
+
+        report, result = audit_compiles(
+            kinds=trainers,
+            mesh=mesh,
+            budgets_path=args.budgets,
+            update=args.update_budgets,
+        )
+        if args.json:
+            report.resources = result.to_rows()
+            print(report.to_json())
+        else:
+            print(format_compile_text(result))
+            if args.update_budgets and not report.findings:
+                print(
+                    "compile budgets written — review and commit the "
+                    "lockfile diff"
+                )
+            if report.findings:
+                print(report.format_text())
+        if args.update_budgets:
+            # findings here mean the update was REFUSED (cross-mesh
+            # partial relock) and nothing was written
+            return 1 if report.findings else 0
+        return report.exit_code(strict=args.strict)
+
     if args.resources:
         _force_cpu_platform()
         from trlx_tpu.analysis.resource_audit import (
@@ -178,7 +226,9 @@ def main(argv=None) -> int:
         print(report.to_json() if args.json else result.format_text())
         return report.exit_code(strict=args.strict)
 
-    if args.engine in ("all", "jaxpr", "nanflow", "collective", "donation"):
+    if args.engine in (
+        "all", "jaxpr", "nanflow", "collective", "donation", "prng",
+    ):
         _force_cpu_platform()
 
     from trlx_tpu.analysis import run

@@ -563,11 +563,10 @@ def lint_source(
     return filter_suppressed(unique, {path: source.splitlines()})
 
 
-def lint_paths(
-    paths: Iterable[str],
-) -> Tuple[List[Finding], List[str], int]:
-    """Lint Python files / directory trees; returns
-    (findings, covered files, suppressed count)."""
+def collect_py_files(paths: Iterable[str]) -> List[str]:
+    """``.py`` files under each path (directories walked in sorted
+    order, bare files kept) — the one discovery every AST engine
+    shares, so exclusion rules land in a single place."""
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -577,6 +576,15 @@ def lint_paths(
                         files.append(os.path.join(root, n))
         elif p.endswith(".py"):
             files.append(p)
+    return files
+
+
+def lint_paths(
+    paths: Iterable[str],
+) -> Tuple[List[Finding], List[str], int]:
+    """Lint Python files / directory trees; returns
+    (findings, covered files, suppressed count)."""
+    files = collect_py_files(paths)
     findings: List[Finding] = []
     n_suppressed = 0
     for f in files:

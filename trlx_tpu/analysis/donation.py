@@ -428,17 +428,10 @@ def check_use_after_donate_source(
 
 def lint_paths(paths: Iterable[str]):
     """use-after-donate over Python files / trees; returns a Report."""
+    from trlx_tpu.analysis.ast_lint import collect_py_files
     from trlx_tpu.analysis.findings import Report
 
-    files: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, _dirs, names in os.walk(p):
-                for n in sorted(names):
-                    if n.endswith(".py"):
-                        files.append(os.path.join(root, n))
-        elif p.endswith(".py"):
-            files.append(p)
+    files = collect_py_files(paths)
     report = Report()
     for f in files:
         try:

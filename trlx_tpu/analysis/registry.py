@@ -21,6 +21,8 @@ ENGINE_COLLECTIVE = "collective"
 ENGINE_SANITIZER = "sanitizer"
 ENGINE_RESOURCE = "resource"
 ENGINE_DONATION = "donation"
+ENGINE_COMPILE = "compile"
+ENGINE_PRNG = "prng"
 
 
 @dataclass(frozen=True)
@@ -240,6 +242,86 @@ register_rule(Rule(
     "buffer; if any later program donates that buffer, every holder of "
     "the forwarded output reads reused memory (the PR-3 behavior-"
     "snapshot hazard: copy per leaf, or donate explicitly).",
+))
+
+# ------------------------- compile-stability rules ----------------------- #
+
+register_rule(Rule(
+    "unexpected-retrace",
+    ENGINE_COMPILE,
+    "no jitted callable recompiles on a steady-state repeat call of the "
+    "trainer's canonical loop (same logical step, stable shapes)",
+    SEVERITY_ERROR,
+    "Silent recompilation is the dominant un-instrumented TPU perf "
+    "killer: one shape-varying call site recompiles the whole train "
+    "step mid-run (~minutes at real shapes) and nothing in the loss "
+    "curves shows it. The finding ships the jaxpr drift — the first "
+    "divergent equation (shape / dtype / weak_type / static-arg) — so "
+    "the cause lands in the report, not just the count.",
+))
+register_rule(Rule(
+    "compile-count-regression",
+    ENGINE_COMPILE,
+    "per-callable compile counts over the canonical short loop stay "
+    "within the committed compile_budgets entries in "
+    "analysis/budgets.json",
+    SEVERITY_ERROR,
+    "The compile-count lockfile turns every new compile into a "
+    "reviewable diff: grow a budget deliberately with "
+    "--compile-audit --update-budgets, never by accident. A count "
+    "regression on the CPU audit mesh is minutes of XLA time at the "
+    "real shapes.",
+))
+register_rule(Rule(
+    "retrace-risk",
+    ENGINE_COMPILE,
+    "no jitted call site in an untraced trainer/orchestrator loop is fed "
+    "a per-step-varying host scalar (len()/.item()/int() of device "
+    "values) or a non-literal static argument",
+    SEVERITY_WARNING,
+    "A Python scalar derived from len()/.item()/int() re-hashes the jit "
+    "cache key every time its value changes: the call site compiles per "
+    "distinct value, and the retrace harness only catches the ones the "
+    "canonical loop happens to exercise. Pass device arrays, or keep "
+    "host scalars step-invariant.",
+))
+
+# --------------------------- PRNG-lineage rules -------------------------- #
+
+register_rule(Rule(
+    "key-reuse",
+    ENGINE_PRNG,
+    "no PRNG key is consumed by more than one random primitive "
+    "(draw/split/fold_in) — every reuse must go through a fresh "
+    "split/fold_in derivation",
+    SEVERITY_ERROR,
+    "Key reuse silently correlates samples: two rollouts drawn from one "
+    "key explore identical trajectories and PPO's gradient variance "
+    "estimates are wrong with no visible symptom in loss curves — the "
+    "failure mode RLHF pipelines are least likely to catch.",
+))
+register_rule(Rule(
+    "key-discard",
+    ENGINE_PRNG,
+    "every jax.random.split advances its chain: the result is consumed "
+    "and the source chain variable (self.rng) is rebound",
+    SEVERITY_WARNING,
+    "A split whose output is dropped (or whose source chain is not "
+    "rebound) repeats the same subkeys at the next call — delayed key "
+    "reuse. `_, key = split(self.rng)` is the classic spelling: every "
+    "subsequent call re-derives the identical key.",
+))
+register_rule(Rule(
+    "fixed-seed",
+    ENGINE_PRNG,
+    "no literal seed reaches training-path randomness outside tests "
+    "(PRNGKey(0)/key(42)/default_rng(7) in trainer/pipeline/orchestrator "
+    "code must come from config)",
+    SEVERITY_WARNING,
+    "A hard-coded seed pins every run of a sampling path to one "
+    "trajectory set: sweeps silently share rollouts, and restarts "
+    "replay the same 'random' experience. Seeds belong to "
+    "train.seed/config so runs are reproducible on purpose.",
 ))
 
 # ---------------------------- AST-lint rules ----------------------------- #

@@ -687,11 +687,18 @@ def audit_resources(
         if existing is not None:
             partial = kinds is not None
             locked_mesh = existing.get("mesh")
+            # lockfile-sourced ints, not device values — normalized
+            # OUTSIDE the branch so the host-branch lint can see this
+            # condition never reads device state
+            locked_norm = (
+                {k: int(v) for k, v in sorted(locked_mesh.items())}
+                if locked_mesh is not None
+                else None
+            )
             if (
                 partial
-                and locked_mesh is not None
-                and {k: int(v) for k, v in sorted(locked_mesh.items())}
-                != budgets["mesh"]
+                and locked_norm is not None
+                and locked_norm != budgets["mesh"]
             ):
                 # a subset trace on a different mesh cannot merge: the
                 # kept entries would be locked for another topology

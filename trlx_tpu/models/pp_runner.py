@@ -53,7 +53,11 @@ from trlx_tpu.ops.attention import (
     combine_biases,
     padding_bias,
 )
-from trlx_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from trlx_tpu.parallel.pipeline import (
+    pipeline_apply,
+    spmd_stack,
+    stack_stage_params,
+)
 
 
 @dataclass(frozen=True)
@@ -91,12 +95,15 @@ def _stack_stages(block_params, stages: int, virtual: int = 1):
     """[L] per-block param trees -> leaves [S, L/S, ...] (stage-major), or
     [S, v, L/(S·v), ...] when ``virtual > 1`` (interleaved: chunk
     c = lap·S + d on device d — round-robin layer placement)."""
+    # per-layer stacking goes through spmd_stack, never jnp.stack: these
+    # arrays feed shard_map P("pp") in_specs, where XLA's SPMD partitioner
+    # miscompiles a stack/concatenate operand under jit on any mesh with
+    # a second size>1 axis (tools/pp_miscompile_repro.py)
     groups = stages * virtual
     per = len(block_params) // groups
     group_trees = [
         jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs, axis=0),
-            *block_params[g * per : (g + 1) * per],
+            spmd_stack, *block_params[g * per : (g + 1) * per]
         )
         for g in range(groups)
     ]

@@ -205,6 +205,19 @@ def make_sampler(
     R = gen_config.max_new_tokens
     cap = Q + R
 
+    def concat_cols(a, b):
+        """[B, Qa] ++ [B, Qb] along axis 1 via dynamic_update_slice.
+
+        NOT jnp.concatenate: the mask this builds feeds the pp decode's
+        shard_map, and XLA's SPMD partitioner mis-lowers a concatenate
+        operand of a shard_map on any mesh with a spare size>1 axis —
+        the same compiler-bug family as the sharded rollout-concat
+        replica-sum (data/ppo_types.py::concat_rollouts) and the stage
+        stacking (tools/pp_miscompile_repro.py)."""
+        buf = jnp.zeros((a.shape[0], a.shape[1] + b.shape[1]), a.dtype)
+        buf = jax.lax.dynamic_update_slice(buf, a, (0, 0))
+        return jax.lax.dynamic_update_slice(buf, b.astype(a.dtype), (0, a.shape[1]))
+
     def pin_cache(cache):
         if cache_sharding is None:
             return cache
@@ -238,7 +251,7 @@ def make_sampler(
         cache = pin_cache(init_cache_fn(B, cap))
         # prefill: cache validity = prompt mask over slots [0, Q)
         pad_tail = jnp.zeros((B, R), dtype=prompt_mask.dtype)
-        cache_mask = jnp.concatenate([prompt_mask, pad_tail], axis=1)
+        cache_mask = concat_cols(prompt_mask, pad_tail)
         positions = jnp.clip(jnp.cumsum(prompt_mask, axis=-1) - 1, 0, None)
         out = apply_fn(
             params,
@@ -302,8 +315,8 @@ def make_sampler(
             ys = (token, live.astype(jnp.int32), logprob, value_out)
 
             # forward the sampled token at slot Q+t
-            cache_mask_t = (slot_ids <= Q + t).astype(jnp.int32) * jnp.concatenate(
-                [prompt_mask, jnp.ones((B, R), prompt_mask.dtype)], axis=1
+            cache_mask_t = (slot_ids <= Q + t).astype(jnp.int32) * concat_cols(
+                prompt_mask, jnp.ones((B, R), prompt_mask.dtype)
             )
             out = apply_fn(
                 params,

@@ -26,11 +26,30 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def spmd_stack(*xs):
+    """``jnp.stack(xs, axis=0)`` built from ``dynamic_update_slice`` writes.
+
+    XLA's SPMD partitioner mis-lowers a ``concatenate``/``stack`` whose
+    output feeds a ``shard_map`` with a ``P("pp")`` in_spec on any mesh
+    with a second size>1 axis: each stage reads wrong slices of the
+    stacked operand (jit-only; eager is exact). Same compiler-bug family
+    as the sharded rollout-concat replica-sum
+    (``data/ppo_types.py::concat_rollouts``); minimal standalone repro +
+    the workaround A/B in ``tools/pp_miscompile_repro.py``. Every
+    stage-stacking path MUST build its [S]-leading arrays through this
+    helper, never ``jnp.stack``/``jnp.concatenate``."""
+    first = xs[0]
+    buf = jnp.zeros((len(xs),) + first.shape, first.dtype)
+    for i, x in enumerate(xs):
+        buf = jax.lax.dynamic_update_slice(
+            buf, x.astype(first.dtype)[None], (i,) + (0,) * first.ndim
+        )
+    return buf
+
+
 def stack_stage_params(params_list):
     """Stack per-stage param pytrees on a leading [S] axis (shard over pp)."""
-    return jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs, axis=0), *params_list
-    )
+    return jax.tree_util.tree_map(spmd_stack, *params_list)
 
 
 def stack_stage_params_interleaved(chunk_trees, stages: int, virtual: int):
@@ -40,9 +59,7 @@ def stack_stage_params_interleaved(chunk_trees, stages: int, virtual: int):
     device_trees = []
     for d in range(stages):
         laps = [chunk_trees[lap * stages + d] for lap in range(virtual)]
-        device_trees.append(
-            jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *laps)
-        )
+        device_trees.append(jax.tree_util.tree_map(spmd_stack, *laps))
     return stack_stage_params(device_trees)
 
 

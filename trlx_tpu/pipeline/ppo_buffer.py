@@ -28,12 +28,15 @@ overlap is purely a dispatch reordering, never a data reordering.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Iterator, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from trlx_tpu.data.ppo_types import PPORolloutBatch, concat_rollouts
 from trlx_tpu.pipeline import BaseRolloutStore
@@ -180,10 +183,27 @@ class PPORolloutBuffer(BaseRolloutStore):
             self._capacity = self._store.batch_size
         if self._landed + n > self._capacity:
             # a non-dividing final chunk overshoots the planned capacity:
-            # grow the store (same dynamic_update_slice discipline)
-            grown = _alloc_store(batch, self._landed + n)
+            # grow the store (same dynamic_update_slice discipline). The
+            # new capacity is rounded up to a power-of-two bucket — an
+            # exact `landed + n` capacity changes the store's (and every
+            # downstream gather's) shapes on EVERY overflow, recompiling
+            # the write/gather programs each time; bucketed growth
+            # reaches a steady-state shape after one resize, so the
+            # compile-stability audit sees one compile, not one per
+            # overflow.
+            need = self._landed + n
+            new_capacity = max(self._capacity, 1)
+            while new_capacity < need:
+                new_capacity *= 2
+            logger.warning(
+                "PPORolloutBuffer stream store overflow: growing %d -> %d "
+                "rows (power-of-two bucket for %d landed rollouts) — "
+                "downstream jitted shapes change once for this bucket",
+                self._capacity, new_capacity, need,
+            )
+            grown = _alloc_store(batch, new_capacity)
             grown = _write_rows(grown, self._store, 0)
-            self._store, self._capacity = grown, self._landed + n
+            self._store, self._capacity = grown, new_capacity
         self._store = _write_rows(self._store, batch, self._landed)
         self._landed += n
         self._full = None

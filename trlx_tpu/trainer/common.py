@@ -157,7 +157,10 @@ def scale_by_adam_low_precision(
         # the 2N uint32 draws a full-model SR store needs — with threefry
         # the RNG cost exceeded the halved-moment traffic saving (measured
         # +120ms vs -40ms per 32-step phase at the bench shape)
-        base = jax.random.fold_in(jax.random.key(0x5EED, impl="rbg"), count)
+        # the literal seed is the CONTRACT here: stochastic rounding must
+        # be bitwise reproducible per (step, leaf) with no RNG state to
+        # checkpoint — it is noise injection, not statistical sampling
+        base = jax.random.fold_in(jax.random.key(0x5EED, impl="rbg"), count)  # tpu-lint: disable=fixed-seed
         leaves_mu, treedef = jax.tree_util.tree_flatten(mu32)
         leaves_nu = treedef.flatten_up_to(nu32)
         keys = jax.random.split(base, 2 * len(leaves_mu))
