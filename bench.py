@@ -387,6 +387,15 @@ def measure_throughput(config, n_phases=5):
 
     monitor = CompileMonitor()
 
+    # span accounting (docs/observability.md): the phase loop is
+    # instrumented by the telemetry tracer — the measured window's span
+    # tree ships in the BENCH payload under stable keys so the perf
+    # trajectory is machine-diffable across rounds (engine 10 gates the
+    # same spans on the CPU tier)
+    from trlx_tpu import telemetry
+
+    tracer = telemetry.configure(enabled=True)
+
     times = {"collect": 0.0, "train": 0.0}
     overlap_saved = {"ms": 0.0, "phases": 0}
     # cost of one forcing fetch = the flat tunnel round trip; subtracted
@@ -445,6 +454,7 @@ def measure_throughput(config, n_phases=5):
         one_phase()  # warmup: compile sampler + fused train phase
         one_phase()  # 2nd warmup: absorbs any donated-buffer relayout retrace
         monitor.mark_steady()  # any compile past here retraced mid-measurement
+        tracer.clear()  # span stats cover the measured phases only
 
         start = time.time()
         for _ in range(n_phases):
@@ -537,7 +547,32 @@ def measure_throughput(config, n_phases=5):
         tgbps = n_phases * steps * step_bytes / times["train"] / 1e9
         out["train_phase_hbm_gbps"] = round(tgbps, 1)
         out["train_phase_hbm_util"] = round(tgbps / hbm_peak, 4)
-    out.update(_static_resources(trainer))
+    # per-phase span tree over the measured window (stable keys: the
+    # engine-10 gated spans as flat *_ms p50s + the full stats table) —
+    # the round-over-round perf diff reads these instead of eyeballing
+    # collect_ms/train_ms
+    span_stats = tracer.stats()
+    for key, flat in (
+        ("phase/collect", "phase/collect_ms"),
+        ("phase/train", "phase/train_ms"),
+        ("train/drain", "phase/drain_ms"),
+    ):
+        if key in span_stats:
+            out[flat] = round(span_stats[key]["p50_ms"], 1)
+    out["spans"] = {
+        name: {
+            "count": int(s["count"]),
+            "p50_ms": round(s["p50_ms"], 2),
+            "p95_ms": round(s["p95_ms"], 2),
+            "total_ms": round(s["total_ms"], 1),
+        }
+        for name, s in span_stats.items()
+    }
+    static_res = _static_resources(trainer)
+    out.update(static_res)
+    out.update(
+        _measured_memory(static_res.get("static_train_step_peak_hbm_gb"))
+    )
     # per-callable compile counts + trace/compile wall time over the
     # whole run (warmups included); steady_compiles > 0 means a program
     # RETRACED inside the measured window — the throughput above paid
@@ -588,6 +623,37 @@ def _static_resources(trainer):
         }
     except Exception as e:  # the measured numbers must still print
         return {"static_resource_error": f"{type(e).__name__}: {e}"}
+
+
+def _measured_memory(static_peak_gb):
+    """Allocator-measured HBM next to the static engine-7 prediction
+    (telemetry/device_metrics.py). The measured value is the PROCESS
+    peak (sampler + snapshot + stream store + train step together), so
+    the ratio against the static train-step contract is a
+    phase-footprint signal — a round-over-round rise means the run's
+    memory grew somewhere the step lockfile does not gate. Reuses the
+    static number `_static_resources` already computed (the engine-7
+    trace costs seconds at the bench shape). Empty on backends without
+    memory_stats (CPU)."""
+    try:
+        from trlx_tpu.telemetry.device_metrics import static_vs_measured
+
+        static_bytes = (
+            int(static_peak_gb * 2**30) if static_peak_gb else None
+        )
+        res = static_vs_measured(static_peak_bytes=static_bytes)
+        out = {}
+        if "measured_peak_hbm_bytes" in res:
+            out["measured_peak_hbm_gb"] = round(
+                res["measured_peak_hbm_bytes"] / 2**30, 3
+            )
+        if "measured_process_peak_over_static_step" in res:
+            out["measured_process_peak_over_static_step"] = res[
+                "measured_process_peak_over_static_step"
+            ]
+        return out
+    except Exception as e:  # the measured numbers must still print
+        return {"measured_memory_error": f"{type(e).__name__}: {e}"}
 
 
 def main():

@@ -36,6 +36,11 @@ safety properties the fsdp/tp NaN divergence exposed:
   dataflow over traced jaxprs plus a host-side split-chain walk of
   ``self.rng`` rebinding (rules ``key-reuse``/``key-discard``/
   ``fixed-seed``).
+- :mod:`trlx_tpu.analysis.perf_audit` — ``--perf-audit`` runs the
+  telemetry-instrumented streamed phase loop and gates measured
+  per-span wall-clock (p50) against the ``perf_budgets`` lockfile
+  section (rule ``perf-regression``) — the first engine watching a
+  *run*, not a trace; see docs/observability.md.
 
 Run ``python -m trlx_tpu.analysis --help`` or see docs/static_analysis.md.
 """

@@ -5,13 +5,15 @@ warnings too, plain mode only errors). Designed for CI on CPU-only
 runners — the jaxpr audit forces an 8-virtual-device CPU platform before
 JAX initializes so collective/sharding structure is real.
 
-Besides the rule engines there are two report modes: ``--sanitize
-<trainer>`` (eqn-level non-finite replay) and ``--resources`` (static
-peak-HBM / collective / FLOP budgets per traced program, gated against
-the committed ``analysis/budgets.json``; ``--update-budgets``
-regenerates the lockfile). JSON output carries a top-level
-``schema_version`` and deterministic ordering so CI artifacts diff
-cleanly.
+Besides the rule engines there are report modes: ``--sanitize
+<trainer>`` (eqn-level non-finite replay), ``--resources`` (static
+peak-HBM / collective / FLOP budgets per traced program), ``--compile-
+audit`` (runtime compile counting), and ``--perf-audit`` (measured
+per-span wall-clock over the instrumented phase loop) — the latter
+three gated against the committed ``analysis/budgets.json`` with
+``--update-budgets`` relocking each engine's own section. JSON output
+carries a top-level ``schema_version`` and deterministic ordering so CI
+artifacts diff cleanly.
 """
 
 from __future__ import annotations
@@ -64,12 +66,44 @@ def main(argv=None) -> int:
         "them against the committed analysis/budgets.json contract",
     )
     parser.add_argument(
+        "--perf-audit",
+        action="store_true",
+        help="instead of the rule engines: run the instrumented streamed "
+        "phase loop (telemetry spans, docs/observability.md), measure "
+        "per-span p50/p95 wall-clock, and gate the stable phase spans "
+        "against the perf_budgets section of analysis/budgets.json "
+        "(--update-budgets relocks; --span-log exports the trace)",
+    )
+    parser.add_argument(
+        "--span-log",
+        metavar="PATH",
+        default=None,
+        help="with --perf-audit: write the audited run's span stream to "
+        "PATH as Perfetto/chrome-tracing JSONL",
+    )
+    parser.add_argument(
+        "--perf-phases",
+        type=int,
+        default=5,
+        help="with --perf-audit: measured phases per run (default 5; "
+        "p50 over these gates the lockfile)",
+    )
+    parser.add_argument(
+        "--plant-slowdown",
+        type=float,
+        default=0.0,
+        metavar="MS",
+        help="with --perf-audit: inject MS milliseconds of host-side "
+        "sleep into every measured phase — self-check that a planted "
+        "regression trips the perf-regression gate",
+    )
+    parser.add_argument(
         "--update-budgets",
         action="store_true",
-        help="with --resources / --compile-audit: regenerate that "
-        "engine's section of the budget lockfile from the current run "
-        "instead of checking against it (review the diff!); each "
-        "engine's relock preserves the other's entries",
+        help="with --resources / --compile-audit / --perf-audit: "
+        "regenerate that engine's section of the budget lockfile from "
+        "the current run instead of checking against it (review the "
+        "diff!); each engine's relock preserves the others' entries",
     )
     parser.add_argument(
         "--budgets",
@@ -179,6 +213,32 @@ def main(argv=None) -> int:
         if args.update_budgets:
             # findings here mean the update was REFUSED (cross-mesh
             # partial relock) and nothing was written
+            return 1 if report.findings else 0
+        return report.exit_code(strict=args.strict)
+
+    if args.perf_audit:
+        _force_cpu_platform()
+        from trlx_tpu.analysis.perf_audit import audit_perf, format_perf_text
+
+        report, rows = audit_perf(
+            budgets_path=args.budgets,
+            update=args.update_budgets,
+            phases=args.perf_phases,
+            slowdown_ms=args.plant_slowdown,
+            span_log=args.span_log,
+        )
+        if args.json:
+            print(report.to_json())
+        else:
+            print(format_perf_text(rows))
+            if args.update_budgets and not report.findings:
+                print(
+                    "perf budgets written — review and commit the "
+                    "lockfile diff"
+                )
+            if report.findings:
+                print(report.format_text())
+        if args.update_budgets:
             return 1 if report.findings else 0
         return report.exit_code(strict=args.strict)
 

@@ -23,6 +23,7 @@ ENGINE_RESOURCE = "resource"
 ENGINE_DONATION = "donation"
 ENGINE_COMPILE = "compile"
 ENGINE_PRNG = "prng"
+ENGINE_PERF = "perf"
 
 
 @dataclass(frozen=True)
@@ -322,6 +323,22 @@ register_rule(Rule(
     "trajectory set: sweeps silently share rollouts, and restarts "
     "replay the same 'random' experience. Seeds belong to "
     "train.seed/config so runs are reproducible on purpose.",
+))
+
+# -------------------------- measured-perf rules -------------------------- #
+
+register_rule(Rule(
+    "perf-regression",
+    ENGINE_PERF,
+    "measured per-span wall-clock (p50 over the instrumented phase loop) "
+    "stays within the committed perf_budgets section of "
+    "analysis/budgets.json (+ per-span tolerance)",
+    SEVERITY_ERROR,
+    "Faithful throughput drifted 167 -> 162 samples/s/chip across five "
+    "bench rounds and only a manual diff caught it: nothing gated "
+    "*measured* time. The span lockfile turns wall-clock drift into a "
+    "failing job — relock deliberately with --perf-audit "
+    "--update-budgets, never by accident.",
 ))
 
 # ---------------------------- AST-lint rules ----------------------------- #

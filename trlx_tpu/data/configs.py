@@ -211,8 +211,15 @@ class TrainConfig:
     # sample: query/response text + raw score) to rollouts_<iter>.jsonl here
     rollout_logging_dir: Optional[str] = None
     # write a jax.profiler trace of the first ~10 optimizer steps here
-    # (SURVEY §5.1: timing stats + optional jax.profiler integration)
+    # (SURVEY §5.1: timing stats + optional jax.profiler integration).
+    # With profile_phase set, this is instead the output directory of the
+    # single-phase window (and streaming stays enabled).
     profile_dir: Optional[str] = None
+    # dump one xplane trace for EXACTLY phase N (one collect→train pair)
+    # into profile_dir (default "profiles"): a programmatic jax.profiler
+    # window opened before phase N's collection dispatches and closed at
+    # its phase boundary — see telemetry/profiler.py, docs/observability.md
+    profile_phase: Optional[int] = None
     tags: List[str] = field(default_factory=list)
 
     @classmethod

@@ -25,6 +25,7 @@ from typing import Callable
 
 import numpy as np
 
+from trlx_tpu import telemetry
 from trlx_tpu.orchestrator import Orchestrator, register_orchestrator
 from trlx_tpu.data.ppo_types import PPORolloutBatch
 from trlx_tpu.ops.ppo_math import PPOConfig
@@ -160,11 +161,16 @@ class PPOOrchestrator(Orchestrator):
         """Enqueue one chunk's device work (sampler + frozen-ref forward)
         without waiting on it. Dispatch is async; the results are consumed
         later, after the *previous* chunk's host-side scoring."""
-        batch, meta = next(self._loader)
+        with telemetry.span("collect/prompt_draw"):
+            batch, meta = next(self._loader)
         batch, meta = self._expand_groups(batch, meta)
-        t = Clock()
-        sample_out = self.trainer.sample(batch.input_ids, batch.attention_mask)
-        dispatch_ms = t.tick()
+        # forced span: its duration IS exp/dispatch_time's increment, so
+        # the stat survives a disabled tracer (span measures, won't record)
+        with telemetry.span("collect/dispatch", force=True) as sp:
+            sample_out = self.trainer.sample(
+                batch.input_ids, batch.attention_mask
+            )
+        dispatch_ms = sp.duration_ms
         # Frozen-reference forward queued right behind generation
         # (SURVEY §7.3 — "call out + re-insert scores without stalling
         # the TPU"): it runs on device while Python scores the batch.
@@ -205,100 +211,115 @@ class PPOOrchestrator(Orchestrator):
         # on-policy: same semantics as the reference's sequential loop
         # (`ppo_orchestrator.py:66-196`).
         streamed_hook = getattr(self.trainer, "on_rollouts_landed", None)
-        try:
-            pending = self._dispatch_chunk()
-            while collected < num_rollouts:
-                batch, meta, sample_out, ref_logprobs, dispatch_ms = pending
-                dispatch_time += dispatch_ms / 1000.0
-                if collected + len(batch.input_ids) < num_rollouts:
-                    pending = self._dispatch_chunk()
+        # one span per phase collect; chunk-level sub-spans (prompt draw,
+        # dispatch, decode wait, score, landing) nest inside it — and any
+        # streamed epoch-1 train dispatch the landing hook performs nests
+        # inside collect/land, making the overlap visible in the trace
+        with telemetry.span(
+            "phase/collect", force=True, rollouts=int(num_rollouts)
+        ):
+            try:
+                pending = self._dispatch_chunk()
+                while collected < num_rollouts:
+                    batch, meta, sample_out, ref_logprobs, dispatch_ms = pending
+                    dispatch_time += dispatch_ms / 1000.0
+                    if collected + len(batch.input_ids) < num_rollouts:
+                        pending = self._dispatch_chunk()
 
-                # time-to-tokens-available: decode_responses blocks on the
-                # device->host copy of the sampler's output, so this is
-                # where generation cost actually lands (the reference's
-                # exp_generate_time meaning); dispatch_time alone reads ~0
-                # because the sampler call above only enqueues work.
-                t = Clock()
-                texts = self.trainer.decode_responses(
-                    sample_out.tokens, sample_out.response_mask
-                )
-                generate_time += t.tick() / 1000.0
-                if meta["prompts_text"][0] is not None:
-                    queries = meta["prompts_text"]
-                else:
-                    queries = self.trainer.decode_queries(
-                        batch.input_ids, batch.attention_mask
-                    )
+                    # time-to-tokens-available: decode_responses blocks on the
+                    # device->host copy of the sampler's output, so this is
+                    # where generation cost actually lands (the reference's
+                    # exp_generate_time meaning); dispatch_time alone reads ~0
+                    # because the sampler call above only enqueues work.
+                    with telemetry.span("collect/decode", force=True) as sp:
+                        texts = self.trainer.decode_responses(
+                            sample_out.tokens, sample_out.response_mask
+                        )
+                    generate_time += sp.duration_ms / 1000.0
+                    if meta["prompts_text"][0] is not None:
+                        queries = meta["prompts_text"]
+                    else:
+                        queries = self.trainer.decode_queries(
+                            batch.input_ids, batch.attention_mask
+                        )
 
-                t = Clock()
-                scores = np.asarray(
-                    self.score(texts, queries, meta["response_gt"]),
-                    dtype=np.float32,
-                )
-                score_time += t.tick() / 1000.0
-                all_scores.append(scores.copy())
-                self._log_rollouts(queries, texts, scores, iter_count)
+                    with telemetry.span("collect/score", force=True) as sp:
+                        scores = np.asarray(
+                            self.score(texts, queries, meta["response_gt"]),
+                            dtype=np.float32,
+                        )
+                    score_time += sp.duration_ms / 1000.0
+                    all_scores.append(scores.copy())
+                    self._log_rollouts(queries, texts, scores, iter_count)
 
-                # reward scaling + clip (`ppo_orchestrator.py:96-112`). The
-                # reference seeds ref stats from the first rollout batch
-                # when unset (`:97-98`) and always advances the running
-                # moments.
-                if self.ref_mean is None:
-                    self.ref_mean, self.ref_std = (
-                        float(scores.mean()), float(scores.std())
-                    )
-                self.running.update(scores)
-                if method.scale_reward == "running":
-                    if self.running.std > 0:
-                        scores = scores / self.running.std
-                elif method.scale_reward == "ref" and self.ref_std:
-                    scores = scores / self.ref_std
-                elif method.scale_reward == "group":
-                    # whiten within each same-prompt group (beyond parity;
-                    # rows are group-contiguous via _expand_groups)
-                    from trlx_tpu.ops.ppo_math import group_whiten
+                    # reward scaling + clip (`ppo_orchestrator.py:96-112`). The
+                    # reference seeds ref stats from the first rollout batch
+                    # when unset (`:97-98`) and always advances the running
+                    # moments.
+                    if self.ref_mean is None:
+                        self.ref_mean, self.ref_std = (
+                            float(scores.mean()), float(scores.std())
+                        )
+                    self.running.update(scores)
+                    if method.scale_reward == "running":
+                        if self.running.std > 0:
+                            scores = scores / self.running.std
+                    elif method.scale_reward == "ref" and self.ref_std:
+                        scores = scores / self.ref_std
+                    elif method.scale_reward == "group":
+                        # whiten within each same-prompt group (beyond parity;
+                        # rows are group-contiguous via _expand_groups)
+                        from trlx_tpu.ops.ppo_math import group_whiten
 
-                    scores = group_whiten(scores, self.group_size)
-                if method.cliprange_reward:
-                    scores = np.clip(
-                        scores, -method.cliprange_reward,
-                        method.cliprange_reward,
-                    )
+                        scores = group_whiten(scores, self.group_size)
+                    if method.cliprange_reward:
+                        scores = np.clip(
+                            scores, -method.cliprange_reward,
+                            method.cliprange_reward,
+                        )
 
-                rewards = self.trainer.compute_rewards(
-                    sample_out.logprobs,
-                    ref_logprobs,
-                    sample_out.response_mask,
-                    scores,
-                )
+                    with telemetry.span("collect/land") as land_sp:
+                        rewards = self.trainer.compute_rewards(
+                            sample_out.logprobs,
+                            ref_logprobs,
+                            sample_out.response_mask,
+                            scores,
+                        )
 
-                self.trainer.buffer.push(
-                    PPORolloutBatch(
-                        query_tokens=batch.input_ids,
-                        query_mask=batch.attention_mask,
-                        response_tokens=sample_out.tokens,
-                        response_mask=sample_out.response_mask,
-                        logprobs=sample_out.logprobs,
-                        values=sample_out.values,
-                        rewards=rewards,
-                    )
-                )
-                collected += len(batch)
-                if streamed_hook is not None:
-                    # streamed phase: let the trainer dispatch every
-                    # epoch-1 minibatch whose rollouts have now landed
-                    # (no-op outside an active stream)
-                    streamed_hook()
-        finally:
+                        self.trainer.buffer.push(
+                            PPORolloutBatch(
+                                query_tokens=batch.input_ids,
+                                query_mask=batch.attention_mask,
+                                response_tokens=sample_out.tokens,
+                                response_mask=sample_out.response_mask,
+                                logprobs=sample_out.logprobs,
+                                values=sample_out.values,
+                                rewards=rewards,
+                            )
+                        )
+                        collected += len(batch)
+                        # post-landing count: this span's chunk is what
+                        # made the total reach `landed`, which is the
+                        # number the stream plan's readiness gates on
+                        land_sp.set(landed=collected)
+                        if streamed_hook is not None:
+                            # streamed phase: let the trainer dispatch every
+                            # epoch-1 minibatch whose rollouts have now landed
+                            # (no-op outside an active stream)
+                            streamed_hook()
+            except BaseException:
+                # drain queued rows to disk even when collection raised
+                # (writer errors suppressed — the active exception wins);
+                # the enclosing `with` closes the span with status=error
+                # and never swallows
+                if self._rollout_writer is not None:
+                    self._rollout_writer.flush(reraise=False)
+                raise
+            # clean path: the phase-end writer drain belongs to the
+            # collect window; a failing drain propagates and the `with`
+            # closes the span as the error it is
             if self._rollout_writer is not None:
-                # drain queued rows to disk even when collection raised;
-                # surface writer errors only on the clean path (an active
-                # exception wins)
-                import sys
-
-                self._rollout_writer.flush(
-                    reraise=sys.exc_info()[0] is None
-                )
+                self._rollout_writer.flush(reraise=True)
 
         exp_time = clock.tick() / 1000.0
         scores_cat = np.concatenate(all_scores)

@@ -1,0 +1,125 @@
+"""Runtime telemetry: span tracing, device metrics, profiler windows.
+
+The static-analysis stack (engines 1–9) gates what a program *should*
+cost before a run; this package watches the run itself:
+
+- :mod:`trlx_tpu.telemetry.tracer` — low-overhead span tracer on one
+  monotonic clock; the phase loop's single timing source (``with
+  telemetry.span("phase/collect"): ...``), with per-name p50/p95 stats
+  and a Perfetto/chrome-tracing JSONL exporter.
+- :mod:`trlx_tpu.telemetry.device_metrics` — ``device.memory_stats()``
+  sampling (live/peak HBM, transfer counters) logged next to the static
+  engine-7 predictions so static-vs-measured gaps become a printed
+  attribution.
+- :mod:`trlx_tpu.telemetry.profiler` — programmatic ``jax.profiler``
+  windows: ``train.profile_phase: N`` dumps one xplane trace for
+  exactly phase N.
+
+Engine 10 (``python -m trlx_tpu.analysis --perf-audit``) gates the
+span durations against the ``perf_budgets`` section of
+``analysis/budgets.json``. See docs/observability.md for the span
+taxonomy and workflows.
+
+The module-level :func:`span` / :func:`get_tracer` API routes through
+one process-global tracer, enabled by default on the main process only
+(rank-0 gating, like ``Logger``); ``TRLX_TELEMETRY=0/1`` overrides.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Optional
+
+from trlx_tpu.telemetry.tracer import (  # noqa: F401
+    NULL_SPAN,
+    Span,
+    Tracer,
+    chrome_trace_events,
+    chrome_trace_from_jsonl,
+    export_chrome_jsonl,
+    monotonic,
+    quantile,
+)
+
+__all__ = [
+    "NULL_SPAN",
+    "Span",
+    "Tracer",
+    "chrome_trace_events",
+    "chrome_trace_from_jsonl",
+    "configure",
+    "export_chrome_jsonl",
+    "get_tracer",
+    "monotonic",
+    "now",
+    "quantile",
+    "scoped_tracer",
+    "span",
+]
+
+_tracer: Optional[Tracer] = None
+
+
+def _default_enabled() -> bool:
+    env = os.environ.get("TRLX_TELEMETRY", "").lower()
+    if env in ("0", "false", "off"):
+        return False
+    if env in ("1", "true", "on"):
+        return True
+    try:
+        # rank-0 gating (multi-host pods trace on the main process only);
+        # lazy so importing telemetry never forces jax initialization
+        from trlx_tpu.parallel.distributed import is_main_process
+
+        return is_main_process()
+    except Exception:
+        return True
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (created on first use)."""
+    global _tracer
+    if _tracer is None:
+        _tracer = Tracer(enabled=_default_enabled())
+    return _tracer
+
+
+def span(name: str, force: bool = False, **attrs):
+    """Open a span on the global tracer (see :meth:`Tracer.span`)."""
+    return get_tracer().span(name, force=force, **attrs)
+
+
+def now() -> float:
+    """The shared monotonic clock, in seconds."""
+    return monotonic()
+
+
+@contextmanager
+def scoped_tracer(tracer: Optional[Tracer] = None):
+    """Temporarily install ``tracer`` (default: a fresh enabled one) as
+    the process-global tracer; the previous tracer — records, enabled
+    flag, everything — is restored on exit. Harnesses that drive
+    instrumented code (the perf audit) use this so their measurement
+    neither wipes nor leaks into the caller's span history."""
+    global _tracer
+    prev = get_tracer()
+    installed = tracer if tracer is not None else Tracer(enabled=True)
+    _tracer = installed
+    try:
+        yield installed
+    finally:
+        _tracer = prev
+
+
+def configure(
+    enabled: Optional[bool] = None, max_records: Optional[int] = None
+) -> Tracer:
+    """Adjust the global tracer; returns it. ``max_records`` resizes
+    the ring (newest records kept; forced evictions count as dropped)."""
+    tracer = get_tracer()
+    if enabled is not None:
+        tracer.enabled = bool(enabled)
+    if max_records is not None:
+        tracer.set_max_records(max_records)
+    return tracer

@@ -1,0 +1,124 @@
+"""Device-memory metrics: measured HBM next to the static predictions.
+
+Engine 7 (``analysis/resource_audit.py``) predicts peak live HBM per
+device *statically*; nothing reported what the allocator actually did,
+so a static-vs-measured gap (fragmentation, un-donated buffers XLA kept,
+runtime scratch) was invisible. This module reads
+``device.memory_stats()`` — the PJRT allocator counters TPUs expose
+(``bytes_in_use`` / ``peak_bytes_in_use`` / transfer counters where the
+runtime provides them) — and turns the gap into a printed attribution.
+
+Everything degrades to empty dicts on backends without the counters
+(CPU returns ``None``), so callers log unconditionally and the keys
+simply vanish on unsupported hardware.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+# allocator counters we surface when present; anything absent is skipped
+_GAUGE_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_reserved",
+               "largest_alloc_size", "bytes_limit",
+               "bytes_reservable_limit")
+# monotonically-increasing counters (per-phase deltas are meaningful)
+_COUNTER_KEYS = ("num_allocs",
+                 "bytes_transferred_to_device",
+                 "bytes_transferred_from_device")
+
+
+def device_memory_stats() -> List[Dict[str, int]]:
+    """Raw ``memory_stats()`` per local device; ``[]`` when the backend
+    has no allocator counters (CPU) or the API raises."""
+    import jax
+
+    out: List[Dict[str, int]] = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            return []
+        if not stats:
+            return []
+        out.append({k: int(v) for k, v in stats.items()
+                    if isinstance(v, (int, float))})
+    return out
+
+
+def snapshot() -> Dict[str, int]:
+    """Aggregated allocator gauges across local devices: the max per
+    gauge (the binding device) and the sum per transfer counter."""
+    per_device = device_memory_stats()
+    if not per_device:
+        return {}
+    agg: Dict[str, int] = {}
+    for key in _GAUGE_KEYS:
+        vals = [s[key] for s in per_device if key in s]
+        if vals:
+            agg[key] = max(vals)
+    for key in _COUNTER_KEYS:
+        vals = [s[key] for s in per_device if key in s]
+        if vals:
+            agg[key] = sum(vals)
+    return agg
+
+
+def phase_memory_stats(prefix: str = "mem/") -> Dict[str, float]:
+    """Loggable per-phase memory stats (empty on CPU): live/peak HBM of
+    the most-loaded device plus any transfer-byte counters — logged next
+    to the per-phase span durations so bytes and milliseconds share a
+    row."""
+    agg = snapshot()
+    out: Dict[str, float] = {}
+    if "bytes_in_use" in agg:
+        out[f"{prefix}hbm_live_bytes"] = float(agg["bytes_in_use"])
+    if "peak_bytes_in_use" in agg:
+        out[f"{prefix}hbm_peak_bytes"] = float(agg["peak_bytes_in_use"])
+    for key in ("bytes_transferred_to_device", "bytes_transferred_from_device"):
+        if key in agg:
+            out[f"{prefix}{key}"] = float(agg[key])
+    return out
+
+
+def static_vs_measured(
+    trainer=None,
+    kind: str = "ppo",
+    static_peak_bytes: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The printed attribution: engine-7's static peak-HBM prediction
+    for the train step next to the allocator's measured peak.
+
+    Semantics matter here: ``peak_bytes_in_use`` is the PROCESS-lifetime
+    high-water mark — it covers the sampler (KV caches), the behavior
+    snapshot, the stream store, and the train step together, while the
+    static number bounds the train step alone. The ratio is therefore a
+    *phase-footprint over step-contract* measure (≥ 1 by construction on
+    a real run), not pure allocator overhead: a growing ratio across
+    rounds means the run's memory grew somewhere the step lockfile does
+    not gate (decode/KV, snapshot, store, or genuine allocator
+    fragmentation/scratch) — the signal to go look, not the diagnosis.
+
+    ``static_peak_bytes`` skips the (seconds-long at real shapes)
+    re-trace when the caller already holds engine-7's number — bench
+    computes it once and passes it in."""
+    out: Dict[str, Any] = {}
+    if static_peak_bytes is not None:
+        out["static_peak_hbm_bytes"] = int(static_peak_bytes)
+    elif trainer is not None:
+        from trlx_tpu.analysis.resource_audit import trainer_step_resources
+
+        try:
+            res = trainer_step_resources(trainer, kind=kind)
+            out["static_peak_hbm_bytes"] = int(res.peak_hbm_bytes)
+        except Exception as e:  # measured numbers must still report
+            out["static_resource_error"] = f"{type(e).__name__}: {e}"
+    agg = snapshot()
+    measured: Optional[int] = agg.get("peak_bytes_in_use")
+    if measured is not None:
+        out["measured_peak_hbm_bytes"] = int(measured)
+        static = out.get("static_peak_hbm_bytes")
+        if static:
+            out["measured_process_peak_over_static_step"] = round(
+                measured / static, 2
+            )
+    return out

@@ -1,0 +1,75 @@
+"""Programmatic ``jax.profiler`` windows: one xplane trace per phase.
+
+The legacy ``train.profile_dir`` path traced the first ~10 optimizer
+steps from loop start — useful for cold-start triage, useless for "what
+did phase 37 overlap with": by step 10 nothing interesting has streamed
+yet, and tracing a whole run is gigabytes. ``train.profile_phase: N``
+instead opens the profiler for EXACTLY phase N (one collect→train pair)
+and closes it at the phase boundary, yielding one loadable xplane/
+Perfetto artifact whose timeline lines up with the span tree the tracer
+recorded for the same phase (shared wall-clock).
+
+The stop fence (``block_until_ready``) sits at a phase boundary that
+already synchronizes (the phase's stats were fetched), so the window
+adds no new device syncs to the steady-state loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class PhaseProfiler:
+    """Start/stop a ``jax.profiler`` trace around one phase.
+
+    Drive with :meth:`on_phase_start` (before the phase's collection
+    dispatches) and :meth:`on_phase_end` (after the phase's updates are
+    consumed). Idempotent and crash-safe: :meth:`close` from a
+    ``finally`` stops a still-open trace so an exception mid-phase
+    cannot leak a running profiler into the next run."""
+
+    def __init__(self, profile_dir: Optional[str], target_phase: Optional[int]):
+        self.profile_dir = profile_dir or "profiles"
+        self.target = target_phase
+        self.active = False
+        self.done = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.target is not None
+
+    def on_phase_start(self, phase_index: int) -> None:
+        if not self.enabled or self.active or self.done:
+            return
+        if phase_index != self.target:
+            return
+        import jax
+
+        jax.profiler.start_trace(self.profile_dir)
+        self.active = True
+
+    def on_phase_end(self, sync: Any = None) -> None:
+        """Close the window if one is open. ``sync`` (e.g. the train
+        state's params) is blocked on first so in-flight device work of
+        the profiled phase lands inside the trace — this boundary is
+        already a sync point in every caller."""
+        if not self.active:
+            return
+        import jax
+
+        if sync is not None:
+            jax.block_until_ready(sync)
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True  # exactly one window per run
+
+    def close(self) -> None:
+        if not self.active:
+            return
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self.active = False

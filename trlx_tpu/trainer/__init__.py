@@ -222,6 +222,12 @@ class BaseRLTrainer(ABC):
         batches so the compiled sampler is reused."""
         if self.eval_pipeline is None:
             return {}
+        from trlx_tpu import telemetry
+
+        with telemetry.span("phase/eval"):
+            return self._evaluate_body()
+
+    def _evaluate_body(self) -> Dict[str, Any]:
         from trlx_tpu.utils import Clock
 
         clock = Clock()

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import functools
 import os
-import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -38,6 +37,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from trlx_tpu import telemetry
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ppo_types import PPORolloutBatch
 from trlx_tpu.models.heads import CausalLMWithValueHead
@@ -123,8 +123,9 @@ def _policy_entropy(logits: jax.Array) -> jax.Array:
 class _StreamedPhase:
     """Host-side state of one streamed collect→train phase
     (docs/async_pipeline.md): the fixed update plan, the dispatch cursor
-    over epoch-1 minibatches, their pending stats, and the wall-clock
-    marks the overlap attribution is computed from."""
+    over epoch-1 minibatches, their pending stats, and the monotonic
+    marks (the tracer's clock) the overlap attribution is computed
+    from."""
 
     def __init__(self, plan: StreamPlan, overlap: bool):
         self.plan = plan
@@ -326,6 +327,15 @@ class PPOTrainer(BaseRLTrainer):
         self._behavior_params = None
         self._last_overlap_stats: Dict[str, float] = {}
         self._last_phase_mean_kl = 0.0
+        # phase counter + single-phase profiler window (telemetry/
+        # profiler.py): _collect_phase opens phase N, the learn-loop's
+        # phase epilogue closes it (train.profile_phase). A disabled
+        # placeholder until learn() arms it so orchestrator-driven runs
+        # outside learn (bench, A/Bs) can hit the hooks safely.
+        from trlx_tpu.telemetry.profiler import PhaseProfiler
+
+        self._phase_index = -1
+        self._phase_profiler = PhaseProfiler(None, None)
 
         self.setup_ep_axis(self.mesh, self.family)
         # MoE families contribute router load-balancing losses to the
@@ -1102,12 +1112,19 @@ class PPOTrainer(BaseRLTrainer):
         while st.next_mb < plan.n_minibatches and (
             force or plan.ready(st.next_mb, landed)
         ):
-            mb = self.buffer.gather(
-                plan.epoch1[st.next_mb], sharding=self._batch_sh
-            )
+            # one span per epoch-1 dispatch: during collection these nest
+            # strictly inside the phase/collect span (via collect/land),
+            # which is how the trace shows what overlapped with what;
+            # forced so the window mark survives a disabled tracer
+            with telemetry.span(
+                "train/epoch1_dispatch", force=True, minibatch=st.next_mb
+            ) as sp:
+                mb = self.buffer.gather(
+                    plan.epoch1[st.next_mb], sharding=self._batch_sh
+                )
+                self.state, stats = self._train_step_jit(self.state, mb)
             if st.t_first_dispatch is None:
-                st.t_first_dispatch = time.time()
-            self.state, stats = self._train_step_jit(self.state, mb)
+                st.t_first_dispatch = sp.start
             st.epoch1_stats.append(stats)
             st.next_mb += 1
 
@@ -1127,37 +1144,48 @@ class PPOTrainer(BaseRLTrainer):
         train = self.config.train
         plan = st.plan
 
-        t_collect_end = time.time()
-        st.dispatched_during_collect = st.next_mb
-        self._dispatch_ready_minibatches(force=True)
-        # Drain: how long the host still waits on epoch-1 device work
-        # after collection ended. A serial schedule pays the WHOLE epoch-1
-        # compute here; overlap pays only the unhidden tail.
-        jax.block_until_ready(st.epoch1_stats[-1])
-        drain_ms = (time.time() - t_collect_end) * 1000.0
-
-        # the snapshot is dead weight for the residual epochs — drop our
-        # reference before the fused dispatch (in-flight consumers keep
-        # the device buffers alive until they complete)
-        self._behavior_params = None
-
+        # All phase timing below is span-sourced (telemetry/tracer.py):
+        # the spans ARE the stopwatches — the same records feed the trace
+        # exporter, bench's span payload, and the --perf-audit lockfile.
+        # Forced spans still measure when the tracer is disabled (the
+        # exp/overlap_* stats stay correct), they just go unrecorded.
         residual_stats = None
         residual_ms = 0.0
-        if plan.residual.size:
-            mbs = self.buffer.gather(
-                plan.residual, sharding=self._stacked_batch_sh
-            )
-            t0 = time.time()
-            self.state, residual_stats = self._train_phase_jit(
-                self.state, mbs
-            )
-            jax.block_until_ready(self.state.params)
-            residual_ms = (time.time() - t0) * 1000.0
+        with telemetry.span(
+            "phase/train", force=True, updates=plan.n_updates
+        ) as train_sp:
+            t_collect_end = train_sp.start
+            st.dispatched_during_collect = st.next_mb
+            # Drain: how long the host still waits on epoch-1 device work
+            # after collection ended (tail dispatches included). A serial
+            # schedule pays the WHOLE epoch-1 compute here; overlap pays
+            # only the unhidden tail. The fence was always at this
+            # boundary — the span adds no new sync.
+            with telemetry.span("train/drain", force=True) as drain_sp:
+                self._dispatch_ready_minibatches(force=True)
+                jax.block_until_ready(st.epoch1_stats[-1])
+            drain_ms = drain_sp.duration_ms
 
-        # one transfer event for every host consumer of the phase
-        e1_rows, res_rows, mean_kl = jax.device_get(
-            (st.epoch1_stats, residual_stats, self.mean_kl)
-        )
+            # the snapshot is dead weight for the residual epochs — drop
+            # our reference before the fused dispatch (in-flight consumers
+            # keep the device buffers alive until they complete)
+            self._behavior_params = None
+
+            if plan.residual.size:
+                mbs = self.buffer.gather(
+                    plan.residual, sharding=self._stacked_batch_sh
+                )
+                with telemetry.span("train/residual", force=True) as res_sp:
+                    self.state, residual_stats = self._train_phase_jit(
+                        self.state, mbs
+                    )
+                    jax.block_until_ready(self.state.params)
+                residual_ms = res_sp.duration_ms
+
+            # one transfer event for every host consumer of the phase
+            e1_rows, res_rows, mean_kl = jax.device_get(
+                (st.epoch1_stats, residual_stats, self.mean_kl)
+            )
         rows: Dict[str, np.ndarray] = {}
         for key in e1_rows[0]:
             seq = np.stack([np.asarray(r[key]) for r in e1_rows])
@@ -1181,7 +1209,9 @@ class PPOTrainer(BaseRLTrainer):
         # interleaved A/B (ab_phase_overlap.py); these stats are the
         # cheap per-phase estimate: epoch-1 serial cost is taken from the
         # residual pass (same programs, (ppo_epochs-1) identical epochs)
-        # when available, else bounded by the dispatch window.
+        # when available, else bounded by the dispatch window. Every term
+        # is span-derived: drain/residual from their span durations, the
+        # window from the first epoch-1 dispatch span's start mark.
         window_ms = (
             max(0.0, (t_collect_end - st.t_first_dispatch) * 1000.0)
             if st.t_first_dispatch is not None
@@ -1201,6 +1231,12 @@ class PPOTrainer(BaseRLTrainer):
             ),
             "exp/phase_residual_ms": residual_ms,
         }
+        # allocator gauges next to the phase timing (empty on backends
+        # without memory_stats, e.g. CPU): live/peak HBM per phase rides
+        # the same stats row the spans feed
+        from trlx_tpu.telemetry.device_metrics import phase_memory_stats
+
+        self._last_overlap_stats.update(phase_memory_stats())
 
         self._stream = None
         return plan.n_updates, rows, kl_seq
@@ -1214,7 +1250,11 @@ class PPOTrainer(BaseRLTrainer):
         mid-pass cadence)."""
         train = self.config.train
         method: PPOConfig = self.config.method
-        if not train.phase_overlap or self.orch is None or train.profile_dir:
+        # profile_dir WITHOUT profile_phase is the legacy first-10-steps
+        # trace, which needs the stepwise path; the single-phase window
+        # (profile_phase) profiles the streamed schedule itself
+        legacy_profile = train.profile_dir and train.profile_phase is None
+        if not train.phase_overlap or self.orch is None or legacy_profile:
             return False
         n_mb = method.num_rollouts // train.batch_size
         if n_mb < 1:
@@ -1252,6 +1292,11 @@ class PPOTrainer(BaseRLTrainer):
         legacy train paths consume. A collection failure aborts the
         stream so a caller's retry starts from a clean slate instead of
         wedging on the stale plan."""
+        # each collection opens a new phase; the profiler window (if one
+        # is configured for this phase index) starts before any of the
+        # phase's device work dispatches
+        self._phase_index += 1
+        self._phase_profiler.on_phase_start(self._phase_index)
         if self._stream_eligible(iter_count):
             self.begin_streamed_phase(seed=seed)
         try:
@@ -1279,6 +1324,15 @@ class PPOTrainer(BaseRLTrainer):
                 # finished run: skip rollout collection entirely
                 self._final_stats = {}
                 return {}
+
+        # single-phase profiler window (train.profile_phase): constructed
+        # before the initial collection so phase 0 is profileable
+        from trlx_tpu.telemetry.profiler import PhaseProfiler
+
+        self._phase_index = -1
+        self._phase_profiler = PhaseProfiler(
+            train.profile_dir, train.profile_phase
+        )
 
         # the loop's step counter must come from BEFORE any streamed
         # epoch-1 update advances state.step during the initial collection
@@ -1312,10 +1366,12 @@ class PPOTrainer(BaseRLTrainer):
             return self._learn_body(logger, total_steps, n_minibatches, start_step)
         finally:
             # single epilogue for every exit (incl. exceptions): stop any
-            # live profiler trace, join in-flight async checkpoint writes
-            # (surfacing background write errors), close the logger even if
-            # that join raises
+            # live profiler trace (legacy first-steps AND the single-phase
+            # window), join in-flight async checkpoint writes (surfacing
+            # background write errors), close the logger even if that
+            # join raises
             try:
+                self._phase_profiler.close()
                 if self._profiling:
                     jax.profiler.stop_trace()
                     self._profiling = False
@@ -1386,7 +1442,9 @@ class PPOTrainer(BaseRLTrainer):
         if iter_count >= total_steps:
             # resumed a finished run: nothing left to train
             return final_stats
-        if train.profile_dir:
+        if train.profile_dir and train.profile_phase is None:
+            # legacy mode: trace the first ~10 optimizer steps from loop
+            # start (profile_phase traces one whole phase instead)
             jax.profiler.start_trace(train.profile_dir)
             self._profiling = True
         for epoch in range(train.epochs):
@@ -1417,6 +1475,9 @@ class PPOTrainer(BaseRLTrainer):
                     if iter_count % train.log_interval == 0:
                         logger.log(step_stats, step=iter_count)
                         final_stats = dict(step_stats)
+                # phase boundary: the profiled phase's updates are done and
+                # fetched — close the window here (no new sync)
+                self._phase_profiler.on_phase_end(sync=self.state.params)
                 final_stats, done = self._end_of_pass(
                     logger, iter_count, total_steps, final_stats, epoch
                 )
@@ -1443,16 +1504,23 @@ class PPOTrainer(BaseRLTrainer):
                 )
             )
             if fused_ok:
-                _, stacked, kl_seq = self.train_on_buffer(
-                    seed=train.seed + epoch, n_minibatches=n_minibatches
-                )
+                # phase/train on the fused path covers dispatch AND the
+                # stats fetch that forces it — the same window the
+                # streamed path's span measures
+                with telemetry.span(
+                    "phase/train", force=True,
+                    updates=n_minibatches * method.ppo_epochs,
+                ):
+                    _, stacked, kl_seq = self.train_on_buffer(
+                        seed=train.seed + epoch, n_minibatches=n_minibatches
+                    )
+                    # one transfer event for the whole stacked stats tree
+                    # + KL state (per-key np.asarray would pay ~100ms per
+                    # leaf on a tunneled chip)
+                    rows, kl_seq, mean_kl = jax.device_get(
+                        (stacked, kl_seq, self.mean_kl)
+                    )
                 phase_time = clock.tick(train.batch_size) / 1000.0
-                # one transfer event for the whole stacked stats tree + KL
-                # state (per-key np.asarray would pay ~100ms per leaf on a
-                # tunneled chip)
-                rows, kl_seq, mean_kl = jax.device_get(
-                    (stacked, kl_seq, self.mean_kl)
-                )
                 self.check_anomalies(rows, iter_count)
                 step_stats = {}
                 for k in range(n_minibatches):
@@ -1466,6 +1534,7 @@ class PPOTrainer(BaseRLTrainer):
                     if iter_count % train.log_interval == 0:
                         logger.log(step_stats, step=iter_count)
                         final_stats = dict(step_stats)
+                self._phase_profiler.on_phase_end(sync=self.state.params)
                 final_stats, done = self._end_of_pass(
                     logger, iter_count, total_steps, final_stats, epoch
                 )
@@ -1525,6 +1594,8 @@ class PPOTrainer(BaseRLTrainer):
                     final_stats.update(eval_stats)
                     self._final_stats = final_stats
                     return final_stats
+            # stepwise pass done — phase boundary for the profiler window
+            self._phase_profiler.on_phase_end(sync=self.state.params)
             # on-policy refresh (post_epoch_callback,
             # `accelerate_ppo_model.py:130-134`)
             if self.orch is not None and epoch < train.epochs - 1:
@@ -1537,17 +1608,20 @@ class PPOTrainer(BaseRLTrainer):
 
     def save(self, directory: Optional[str] = None) -> None:
         directory = directory or self.config.train.checkpoint_dir
-        # one batched fetch for all host-side save inputs
-        kl_coef, mean_kl, step = jax.device_get(
-            (self.kl_coef, self.mean_kl, self.state.step)
-        )
-        save_checkpoint(
-            directory,
-            self.state,
-            metadata={"kl_coef": float(kl_coef), "mean_kl": float(mean_kl)},
-            async_save=self.config.train.async_checkpoint,
-            step=int(step),
-        )
+        with telemetry.span("phase/checkpoint"):
+            # one batched fetch for all host-side save inputs
+            kl_coef, mean_kl, step = jax.device_get(
+                (self.kl_coef, self.mean_kl, self.state.step)
+            )
+            save_checkpoint(
+                directory,
+                self.state,
+                metadata={
+                    "kl_coef": float(kl_coef), "mean_kl": float(mean_kl),
+                },
+                async_save=self.config.train.async_checkpoint,
+                step=int(step),
+            )
 
     def load(self, directory: str) -> None:
         abstract = jax.tree_util.tree_map(

@@ -13,12 +13,17 @@ import math
 import os
 import random
 import subprocess
-import time
 from typing import Any, Dict, Iterable, List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# the repo's single timing source (telemetry/tracer.py): every reported
+# duration — Clock ticks, Logger timestamps, spans, the perf lockfile —
+# shares this monotonic clock, so numbers are comparable and immune to
+# wall-clock steps (NTP adjustments skewed time.time() deltas)
+from trlx_tpu.telemetry.tracer import monotonic
 
 
 def set_seed(seed: int) -> jax.Array:
@@ -59,16 +64,17 @@ class Clock:
 
     Mirrors the reference Clock's API (tick returns ms since last tick;
     get_stat reports time-per-1000-samples) so trainer timing stats keep the
-    same meaning.
+    same meaning. Reads the tracer's monotonic clock — one timebase for
+    Clock ticks and span durations.
     """
 
     def __init__(self):
-        self.start = time.time()
+        self.start = monotonic()
         self.total_time = 0.0
         self.total_samples = 0
 
     def tick(self, samples: int = 0) -> float:
-        end = time.time()
+        end = monotonic()
         delta = end - self.start
         self.start = end
         if samples != 0:

@@ -13,9 +13,9 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 from typing import Any, Dict, Optional
 
+from trlx_tpu.telemetry.tracer import monotonic
 from trlx_tpu.utils import filter_non_scalars, get_git_tag
 
 
@@ -31,7 +31,9 @@ class Logger:
         total_steps: Optional[int] = None,
     ):
         self.stream = stream or sys.stdout
-        self.start = time.time()
+        # the tracer's monotonic clock, not time.time(): logged "time"
+        # deltas share the timebase of every span/Clock measurement
+        self.start = monotonic()
         self._wandb = None
         # interactive tqdm progress line (reference shows a tqdm bar with a
         # live loss description, `accelerate_base_model.py:245-297`);
@@ -60,7 +62,16 @@ class Logger:
                     tags=[*tags, get_git_tag()],
                     mode=os.environ.get("WANDB_MODE", "offline"),
                 )
-            except Exception:
+            except Exception as e:
+                # one visible line, not silence: a misconfigured tracker
+                # (bad API key, unwritable dir, version clash) used to be
+                # indistinguishable from wandb-not-installed — runs ended
+                # with no curves and no clue why
+                print(
+                    f"warning: wandb init failed, logging to stdout only "
+                    f"({type(e).__name__}: {e})",
+                    file=sys.stderr,
+                )
                 self._wandb = None
 
     def log(self, stats: Dict[str, Any], step: Optional[int] = None) -> None:
@@ -82,7 +93,7 @@ class Logger:
                 leaves[i] = v
             stats = jax.tree_util.tree_unflatten(treedef, leaves)
         scalars = filter_non_scalars(stats)
-        record = {"step": step, "time": round(time.time() - self.start, 2), **scalars}
+        record = {"step": step, "time": round(monotonic() - self.start, 2), **scalars}
         if self._pbar is not None:
             # erase the live bar first: stdout and stderr often share the
             # terminal, and printing at the bar's cursor garbles both
