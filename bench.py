@@ -313,6 +313,20 @@ def _workload_config(num_layers_unfrozen, ref_branch_layers):
                 "lr_target": 1.412e-4,
                 "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
                 "dtype": "bfloat16",
+                # run-health monitoring on (docs/observability.md): the
+                # fused health scalars ride the phase's existing stats
+                # transfer and the detector/event counts ship in the
+                # BENCH payload — a bench round that tripped kl-spike or
+                # entropy-collapse is not a clean perf sample.
+                # SERIES NOTE (r06+): enabling health adds real device
+                # work to the timed train step (full-vocab softmax
+                # entropy at ent_coef=0, reward quantiles) — a one-time,
+                # instrumentation-caused discontinuity vs the r01-r05
+                # series; attribute any small train-phase delta at r06
+                # here first before hunting regressions (the CPU perf
+                # gate's harness keeps health off, so engine 10's
+                # lockfile is unaffected)
+                "health": {"enabled": True},
             },
             "method": {
                 "name": "PPOConfig",
@@ -568,6 +582,17 @@ def measure_throughput(config, n_phases=5):
         }
         for name, s in span_stats.items()
     }
+    # ring evictions skew the p50s above with no other signal — surface
+    # the count in the payload and warn once on stderr when nonzero
+    out["spans_dropped"] = telemetry.warn_on_span_drops(tracer)
+    # run-health summary (docs/observability.md): detector trip counts
+    # over the measured window (a tripped kl-spike/entropy-collapse
+    # means the throughput sample rode a diverging run) + the last
+    # observed training-dynamics scalars
+    monitor = getattr(trainer, "health_monitor", None)
+    if monitor is not None:
+        out["health_events"] = dict(sorted(monitor.event_counts.items()))
+        out["health"] = monitor.health_summary()
     static_res = _static_resources(trainer)
     out.update(static_res)
     out.update(

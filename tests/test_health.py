@@ -1,0 +1,728 @@
+"""Run-health monitoring (telemetry/health.py + flight_recorder.py).
+
+Detector-engine units (each rule's seeded/clean pair, warmup/cooldown,
+device-array skipping), flight-recorder record/dump/inspect round trips,
+the on_error policy matrix (warn/dump/abort) through the real trainer
+hook, the transfer-count regression tests (one host transfer per
+stepwise PPO update and per ILQL chunk, INCLUDING the new health
+scalars — the PR-1 batched-transfer discipline), and the end-to-end
+planted-anomaly smoke (nightly tier; the CI `health-smoke` job runs the
+same check per PR via the CLI).
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("WANDB_DISABLED", "1")
+
+
+def _monitor(**cfg_kwargs):
+    from trlx_tpu.telemetry.health import HealthConfig, HealthMonitor
+
+    defaults = dict(enabled=True, warmup=4, window=8, cooldown=4)
+    defaults.update(cfg_kwargs)
+    return HealthMonitor(HealthConfig(**defaults), fingerprint="deadbeef0123")
+
+
+# --------------------------- detector units --------------------------- #
+
+
+def test_kl_spike_zscore_trips_after_warmup_and_respects_cooldown():
+    mon = _monitor()
+    rng = np.random.default_rng(0)
+    for _ in range(8):
+        evs = mon.observe(
+            {"policy/mean_rollout_kl": 0.1 + 0.01 * rng.standard_normal()}
+        )
+        assert evs == []  # clean series never trips
+    evs = mon.observe({"policy/mean_rollout_kl": 25.0})
+    assert [e.detector for e in evs] == ["kl-spike"]
+    ev = evs[0]
+    assert ev.severity == "error"
+    assert ev.series == "policy/mean_rollout_kl"
+    assert ev.zscore > 8.0
+    assert ev.fingerprint == "deadbeef0123"
+    assert ev.window  # recent run-up context rides the event
+    # cooldown: the immediately-following rows stay quiet even if high
+    assert mon.observe({"policy/mean_rollout_kl": 30.0}) == []
+    assert mon.event_counts == {"kl-spike": 1}
+
+
+def test_zscore_needs_warmup_and_absolute_floor():
+    mon = _monitor(warmup=6)
+    # a spike BEFORE warmup must not trip (startup transients)
+    for v in (0.1, 0.1, 50.0):
+        assert mon.observe({"policy/mean_rollout_kl": v}) == []
+    # microscopic series: huge relative jump below min_abs stays quiet
+    mon2 = _monitor()
+    for _ in range(8):
+        mon2.observe({"policy/mean_rollout_kl": 1e-6})
+    assert mon2.observe({"policy/mean_rollout_kl": 1e-4}) == []
+
+
+def test_entropy_collapse_trips_on_drop_not_on_low_baseline():
+    mon = _monitor()
+    for _ in range(6):
+        assert mon.observe({"health/entropy": 3.0}) == []
+    evs = mon.observe({"health/entropy": 0.05})
+    assert [e.detector for e in evs] == ["entropy-collapse"]
+    assert evs[0].severity == "error"
+    # a series that was ALWAYS near zero has no baseline to collapse
+    # from (min_baseline guard) — never trips
+    mon2 = _monitor()
+    for _ in range(10):
+        assert mon2.observe({"health/entropy": 0.01}) == []
+
+
+def test_ratio_explosion_absolute_bound_no_warmup():
+    mon = _monitor()
+    # armed immediately: log-ratio past the bound is an error on row 1
+    evs = mon.observe({"health/log_ratio_max": 6.0})
+    assert [e.detector for e in evs] == ["ratio-explosion"]
+    assert evs[0].threshold == 4.0
+    assert _monitor().observe({"health/log_ratio_max": 0.5}) == []
+
+
+def test_grad_spike_is_warning_severity():
+    mon = _monitor()
+    for _ in range(8):
+        mon.observe({"optimizer/grad_norm": 2.0})
+    evs = mon.observe({"optimizer/grad_norm": 400.0})
+    assert [(e.detector, e.severity) for e in evs] == [
+        ("grad-spike", "warning")
+    ]
+
+
+def test_reward_saturation_flatline_patience():
+    mon = _monitor()
+    for _ in range(7):
+        assert mon.observe({"health/reward_std": 0.0}) == []
+    evs = mon.observe({"health/reward_std": 0.0})  # 8th consecutive
+    assert [e.detector for e in evs] == ["reward-saturation"]
+    assert evs[0].severity == "warning"
+    # a live reward signal resets the run
+    mon2 = _monitor()
+    for i in range(20):
+        assert mon2.observe({"health/reward_std": 0.0 if i % 3 else 0.5}) == []
+
+
+def test_nan_precursor_nonfinite_and_huge():
+    mon = _monitor()
+    evs = mon.observe({"losses/total_loss": float("nan")})
+    assert [e.detector for e in evs] == ["nan-precursor"]
+    assert evs[0].severity == "error"
+    evs = mon.observe({"optimizer/grad_norm": 1e12})
+    assert [e.detector for e in evs] == ["nan-precursor"]
+    # the NaN is dropped before touching EWMA state: later rows are sane
+    mon.observe({"losses/total_loss": 1.0})
+    st = mon.state_summary()["losses/total_loss"]
+    assert math.isfinite(st["ewma"])
+
+
+def test_nan_precursor_cooldown_independent_and_ewma_protected():
+    """(1) Cooldown is per (detector, series): a grad-spike warning must
+    not silence the nan-precursor on the same key. (2) A huge-but-finite
+    sample stays OUT of the EWMA so the next normal row is not a
+    spurious collapse/spike."""
+    mon = _monitor()
+    for _ in range(8):
+        mon.observe({"optimizer/grad_norm": 2.0})
+    evs = mon.observe({"optimizer/grad_norm": 400.0})
+    assert [e.detector for e in evs] == ["grad-spike"]
+    # within grad-spike's cooldown, the NaN still reaches nan-precursor
+    evs = mon.observe({"optimizer/grad_norm": float("nan")})
+    assert [e.detector for e in evs] == ["nan-precursor"]
+
+    mon2 = _monitor()
+    for _ in range(6):
+        mon2.observe({"health/entropy": 3.0})
+    evs = mon2.observe({"health/entropy": 2e8})
+    assert [e.detector for e in evs] == ["nan-precursor"]
+    # baseline unpoisoned: the next normal row is clean, not a collapse
+    assert mon2.observe({"health/entropy": 3.0}) == []
+    assert abs(mon2.state_summary()["health/entropy"]["ewma"] - 3.0) < 0.1
+
+
+def test_monitor_never_forces_a_device_transfer():
+    """A still-on-device stat (jax.Array) is skipped, not fetched — the
+    monitor only consumes rows the trainer already paid to transfer."""
+    import jax.numpy as jnp
+
+    mon = _monitor()
+    mon.observe({"policy/mean_rollout_kl": jnp.zeros(()), "losses/x": 1.0})
+    assert "policy/mean_rollout_kl" not in mon.latest
+    assert mon.latest["losses/x"] == 1.0
+
+
+def test_health_config_validation_and_overrides():
+    from trlx_tpu.telemetry.health import HealthConfig
+
+    with pytest.raises(ValueError, match="Unknown train.health keys"):
+        HealthConfig.from_dict({"enabled": True, "windoww": 3})
+    with pytest.raises(ValueError, match="on_error"):
+        HealthConfig.from_dict({"on_error": "explode"})
+    with pytest.raises(ValueError, match="unknown health detector"):
+        HealthConfig.from_dict({"detectors": {"kl-spik": {}}})
+    with pytest.raises(ValueError, match="unknown health detector"):
+        HealthConfig.from_dict({"disable": ["nope"]})
+    # a tuning typo inside a detector override refuses loudly too
+    with pytest.raises(ValueError, match="tunable"):
+        HealthConfig.from_dict({"detectors": {"kl-spike": {"zmx": 20.0}}})
+    # ... and so does a misspelled severity (it would silently never
+    # match the on_error policy's error filter)
+    with pytest.raises(ValueError, match="severity"):
+        HealthConfig.from_dict(
+            {"detectors": {"kl-spike": {"severity": "eror"}}}
+        )
+    # per-detector override + disable are honored
+    cfg = HealthConfig.from_dict(
+        {
+            "enabled": True,
+            "warmup": 2,
+            "detectors": {"ratio-explosion": {"threshold": 100.0}},
+            "disable": ["kl-spike"],
+        }
+    )
+    from trlx_tpu.telemetry.health import HealthMonitor
+
+    mon = HealthMonitor(cfg)
+    assert mon.observe({"health/log_ratio_max": 6.0}) == []  # raised bound
+    for _ in range(8):
+        mon.observe({"policy/mean_rollout_kl": 0.1})
+    assert mon.observe({"policy/mean_rollout_kl": 50.0}) == []  # disabled
+
+
+def test_config_fingerprint_stable_and_sensitive():
+    from trlx_tpu.telemetry.health import config_fingerprint
+
+    a = {"train": {"seed": 1}, "method": {"name": "PPOConfig"}}
+    assert config_fingerprint(a) == config_fingerprint(dict(a))
+    assert config_fingerprint(a) != config_fingerprint(
+        {"train": {"seed": 2}, "method": {"name": "PPOConfig"}}
+    )
+    assert len(config_fingerprint(a)) == 12
+
+
+# ------------------------- flight recorder --------------------------- #
+
+
+def _recorder(tmp_path, **kw):
+    from trlx_tpu.telemetry.flight_recorder import FlightRecorder
+
+    defaults = dict(
+        capacity=4, directory=str(tmp_path), fingerprint="feedface0123",
+        config={"train": {"seed": 1}},
+    )
+    defaults.update(kw)
+    return FlightRecorder(**defaults)
+
+
+def test_flight_recorder_ring_dump_and_inspect_roundtrip(tmp_path):
+    from trlx_tpu.telemetry.flight_recorder import inspect_dump, load_dump
+    from trlx_tpu.telemetry.health import HealthEvent
+
+    rec = _recorder(tmp_path)
+    for phase in range(6):  # capacity 4: oldest two evicted
+        ev = []
+        if phase == 5:
+            ev = [HealthEvent(
+                detector="kl-spike", severity="error",
+                series="policy/mean_rollout_kl", value=21.0, step=30,
+                phase=5, message="kl blew up",
+            )]
+        rec.record_phase(
+            phase, step=phase * 6,
+            stats_row={"losses/total_loss": 0.1 * (phase + 1),
+                       "health/entropy": 3.0 if phase < 5 else 0.01},
+            kl_seq=[0.02, 0.021],
+            events=ev,
+        )
+    assert len(rec) == 4
+    path = rec.dump("detector:kl-spike", once=True)
+    assert path is not None and os.path.exists(path)
+    # once=True dedupes by reason
+    assert rec.dump("detector:kl-spike", once=True) is None
+
+    payload = load_dump(path)
+    assert payload["schema_version"] == 1
+    assert payload["fingerprint"] == "feedface0123"
+    assert [p["phase"] for p in payload["phases"]] == [2, 3, 4, 5]
+    assert payload["phases"][-1]["good"] is False
+    assert payload["phases"][-2]["good"] is True
+
+    view = inspect_dump(payload)
+    assert "kl-spike" in view and "x1" in view
+    # the last-good diff names the collapsed series
+    assert "last-good phase 4 -> final phase 5" in view
+    assert "health/entropy" in view
+
+
+def test_flight_dump_drops_device_leaves_never_forces(tmp_path):
+    import jax.numpy as jnp
+
+    rec = _recorder(tmp_path)
+    rec.record_phase(
+        0, stats_row={"losses/x": 1.0, "policy/mean_rollout_kl": jnp.zeros(())}
+    )
+    path = rec.dump("manual")
+    payload = json.load(open(path))
+    row = payload["phases"][0]["stats"]
+    assert row == {"losses/x": 1.0}
+
+
+def test_dump_on_exception_once_and_abort_dedupe(tmp_path):
+    from trlx_tpu.telemetry.health import HealthAbort
+
+    rec = _recorder(tmp_path)
+    rec.record_phase(0, stats_row={"losses/x": 1.0})
+    err = ValueError("boom")
+    path = rec.dump_on_exception(err)
+    assert path and os.path.exists(path)
+    payload = json.load(open(path))
+    assert payload["error"]["type"] == "ValueError"
+    assert "boom" in payload["error"]["message"]
+    # at most one exception dump per recorder
+    assert rec.dump_on_exception(err) is None
+
+    # a HealthAbort whose detector already dumped is not dumped again
+    rec2 = _recorder(tmp_path)
+    rec2.record_phase(0)
+    rec2.dump("detector:kl-spike", once=True)
+    assert rec2.dump_on_exception(HealthAbort("tripped")) is None
+    # ... but with no prior dump the abort still produces forensics
+    rec3 = _recorder(tmp_path)
+    rec3.record_phase(0)
+    assert rec3.dump_on_exception(HealthAbort("tripped")) is not None
+
+
+def test_exception_dump_keeps_last_real_phase_and_folds_events(tmp_path):
+    """Crash-preempted events fold into the NEWEST ring record — a
+    fresh stats-less record would displace the real final phase and
+    empty --inspect's last-good stats diff (the flagship NaN-crash
+    triage)."""
+    from trlx_tpu.telemetry.flight_recorder import inspect_dump, load_dump
+    from trlx_tpu.telemetry.health import HealthEvent
+
+    rec = _recorder(tmp_path)
+    rec.record_phase(0, stats_row={"losses/x": 1.0, "health/entropy": 3.0})
+    rec.record_phase(1, stats_row={"losses/x": 9.0, "health/entropy": 0.1})
+    ev = HealthEvent(
+        detector="nan-precursor", severity="error", series="losses/x",
+        value=float("nan"), step=12, phase=1, message="went NaN",
+    )
+    rec.note_events([ev])
+    path = rec.dump_on_exception(RuntimeError("training diverged"))
+    payload = load_dump(path)
+    # the final phase is still the REAL phase-1 record, now bad
+    assert [p["phase"] for p in payload["phases"]] == [0, 1]
+    assert payload["phases"][-1]["good"] is False
+    assert payload["phases"][-1]["stats"]["losses/x"] == 9.0
+    view = inspect_dump(payload)
+    assert "last-good phase 0 -> final phase 1" in view
+    assert "nan-precursor" in view
+    # the signed diff reads as a collapse, not an increase
+    assert "-97%" in view or "-96%" in view  # entropy 3.0 -> 0.1
+
+
+def test_span_window_survives_tracer_clear():
+    """The per-phase span watermark must reset when the tracer is
+    cleared (bench clears before its measured window) — a stale
+    watermark would filter every later span forever."""
+    from trlx_tpu import telemetry
+    from trlx_tpu.telemetry.flight_recorder import _span_stats_window
+
+    with telemetry.scoped_tracer() as tracer:
+        for _ in range(5):
+            with telemetry.span("phase/collect"):
+                pass
+        stats, mark = _span_stats_window(-1)
+        assert stats["phase/collect"]["count"] == 5 and mark >= 4
+        tracer.clear()  # indices restart at 0
+        with telemetry.span("phase/train"):
+            pass
+        stats, mark2 = _span_stats_window(mark)
+        assert stats == {"phase/train": stats["phase/train"]}
+        assert stats["phase/train"]["count"] == 1
+
+
+def test_inspect_cli_renders_and_rejects_garbage(tmp_path, capsys):
+    from trlx_tpu.telemetry.__main__ import main
+
+    rec = _recorder(tmp_path)
+    rec.record_phase(0, stats_row={"losses/x": 1.0})
+    path = rec.dump("manual")
+    assert main(["--inspect", path]) == 0
+    out = capsys.readouterr().out
+    assert "flight dump: reason=manual" in out
+    assert main(["--inspect", path, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["reason"] == "manual"
+    assert summary["phases_recorded"] == 1
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert main(["--inspect", str(bad)]) == 2
+    # wrong schema version refuses with a clear error
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text(json.dumps({"schema_version": 99}))
+    assert main(["--inspect", str(wrong)]) == 2
+
+
+# ----------------- on_error policy through the trainer ---------------- #
+
+
+def _stub_trainer(tmp_path, on_error):
+    """A model-free BaseRLTrainer subclass: health wiring only."""
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.trainer import BaseRLTrainer
+
+    class _Stub(BaseRLTrainer):
+        def learn(self):  # pragma: no cover - unused
+            pass
+
+        def sample(self, prompt_ids, prompt_mask):  # pragma: no cover
+            pass
+
+        def save(self, directory=None):  # pragma: no cover - unused
+            pass
+
+        def load(self, directory):  # pragma: no cover - unused
+            pass
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {},
+            "train": {
+                "health": {
+                    "enabled": True,
+                    "on_error": on_error,
+                    "dump_dir": str(tmp_path),
+                    "warmup": 2,
+                },
+            },
+            "method": {"name": "PPOConfig"},
+        }
+    )
+    return _Stub(config)
+
+
+def test_on_error_warn_logs_but_never_dumps(tmp_path, capsys):
+    trainer = _stub_trainer(tmp_path, "warn")
+    trainer.observe_health({"health/log_ratio_max": 9.0}, step=3, phase=0)
+    err = capsys.readouterr().err
+    assert "ratio-explosion" in err
+    assert trainer.flight_recorder.dumped == []
+    assert trainer.health_monitor.event_counts == {"ratio-explosion": 1}
+
+
+def test_on_error_dump_writes_forensics_with_offending_row(tmp_path):
+    trainer = _stub_trainer(tmp_path, "dump")
+    trainer.observe_health(
+        {"health/log_ratio_max": 9.0, "losses/total_loss": 0.5},
+        step=7, phase=2,
+    )
+    assert len(trainer.flight_recorder.dumped) == 1
+    payload = json.load(open(trainer.flight_recorder.dumped[0]))
+    assert payload["reason"] == "detector:ratio-explosion"
+    last = payload["phases"][-1]
+    assert last["good"] is False
+    assert last["stats"]["health/log_ratio_max"] == 9.0
+    assert [e["detector"] for e in last["events"]] == ["ratio-explosion"]
+    # repeat trips of the same detector do not spray files
+    mon = trainer.health_monitor
+    mon._quiet.clear()  # lift the (detector, series) cooldown
+    trainer.observe_health({"health/log_ratio_max": 9.5}, step=8, phase=2)
+    assert len(trainer.flight_recorder.dumped) == 1
+
+
+def test_on_error_abort_dumps_then_raises(tmp_path):
+    from trlx_tpu.telemetry.health import HealthAbort
+
+    trainer = _stub_trainer(tmp_path, "abort")
+    with pytest.raises(HealthAbort, match="ratio-explosion"):
+        trainer.observe_health({"health/log_ratio_max": 9.0}, step=1)
+    assert len(trainer.flight_recorder.dumped) == 1
+
+
+def test_flight_dump_phase_on_demand(tmp_path):
+    trainer = _stub_trainer(tmp_path, "warn")
+    trainer.config.train.flight_dump_phase = 1
+    trainer.record_flight_phase(0, stats_row={"losses/x": 1.0})
+    assert trainer.flight_recorder.dumped == []
+    trainer.record_flight_phase(1, stats_row={"losses/x": 2.0})
+    assert len(trainer.flight_recorder.dumped) == 1
+    payload = json.load(open(trainer.flight_recorder.dumped[0]))
+    assert payload["reason"] == "flight_dump_phase:1"
+    assert [p["phase"] for p in payload["phases"]] == [0, 1]
+
+
+def test_health_disabled_is_free_and_hookless(tmp_path):
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.trainer import BaseRLTrainer
+
+    class _Stub(BaseRLTrainer):
+        def learn(self):  # pragma: no cover - unused
+            pass
+
+        def sample(self, *a):  # pragma: no cover - unused
+            pass
+
+        def save(self, directory=None):  # pragma: no cover - unused
+            pass
+
+        def load(self, directory):  # pragma: no cover - unused
+            pass
+
+    config = TRLConfig.from_dict(
+        {"model": {}, "train": {}, "method": {"name": "PPOConfig"}}
+    )
+    t = _Stub(config)
+    assert t.health_monitor is None and t.flight_recorder is None
+    assert not t._health_enabled
+    # hooks are safe no-ops
+    t.observe_health({"health/log_ratio_max": 99.0})
+    t.record_flight_phase(0, stats_row={})
+    t.flight_dump_on_exception(ValueError("x"))
+
+
+# ------------------ transfer-count regression tests ------------------- #
+#
+# The PR-1 batched-transfer discipline: every host consumer of a step's
+# stats shares ONE device_get. The health scalars ride that same
+# transfer — these tests pin the count WITH health enabled, so stat
+# creep (a per-key float(), a second fetch) fails loudly.
+
+
+class _CountingDeviceGet:
+    def __init__(self, monkeypatch):
+        import jax
+
+        self.count = 0
+        self._real = jax.device_get
+
+        def counted(x):
+            self.count += 1
+            return self._real(x)
+
+        monkeypatch.setattr(jax, "device_get", counted)
+
+
+def _tiny_arch():
+    return {
+        "vocab_size": 12,
+        "n_positions": 16,
+        "n_embd": 16,
+        "n_layer": 1,
+        "n_head": 1,
+    }
+
+
+def _push_rollouts(trainer, rows, Q=2, R=3, seed=0):
+    import jax.numpy as jnp
+
+    from trlx_tpu.data.ppo_types import PPORolloutBatch
+
+    rng = np.random.default_rng(seed)
+    trainer.buffer.push(
+        PPORolloutBatch(
+            query_tokens=jnp.asarray(
+                rng.integers(1, 10, (rows, Q)), jnp.int32
+            ),
+            query_mask=jnp.ones((rows, Q), jnp.int32),
+            response_tokens=jnp.asarray(
+                rng.integers(1, 10, (rows, R)), jnp.int32
+            ),
+            response_mask=jnp.ones((rows, R), jnp.int32),
+            logprobs=jnp.asarray(
+                -np.abs(rng.normal(1.5, 0.5, (rows, R))), jnp.float32
+            ),
+            values=jnp.asarray(rng.normal(0, 0.3, (rows, R)), jnp.float32),
+            rewards=jnp.asarray(rng.normal(0, 0.5, (rows, R)), jnp.float32),
+        )
+    )
+
+
+def test_stepwise_ppo_health_parity_and_one_transfer_per_update(
+    monkeypatch, tmp_path
+):
+    """Two pins on ONE tiny trainer (tier-1 budget):
+
+    1. **Step-level bitwise parity canary** for the nightly full-phase
+       pin (test_phase_overlap.py::test_health_on_matches_health_off_
+       bitwise_dp): the same train step from the same state and
+       minibatch produces bitwise-identical params with health on vs
+       off — the health scalars are extra outputs, never loss inputs.
+    2. **Transfer-count regression**: the stepwise loop's per-step
+       stats fetch stays ONE device_get per minibatch with the fused
+       health scalars riding it (the PR-1 batched-transfer
+       discipline vs stat creep).
+    """
+    import jax
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {"model_type": "gpt2", "model_arch": _tiny_arch()},
+            "train": {
+                "seq_length": 2,
+                "batch_size": 8,
+                "epochs": 1,
+                "total_steps": 2,
+                "log_interval": 1,
+                # interior eval boundary at step 1 -> the fused pass is
+                # ineligible and the legacy STEPWISE loop runs (eval is
+                # a no-op: no eval pipeline is bound)
+                "eval_interval": 1,
+                "checkpoint_interval": 10000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "float32",
+                "health": {"enabled": True, "dump_dir": str(tmp_path)},
+            },
+            "method": {
+                "name": "PPOConfig",
+                "num_rollouts": 16,
+                "chunk_size": 8,
+                "ppo_epochs": 1,
+                "gen_kwargs": {
+                    "max_new_tokens": 3,
+                    "eos_token_id": 10,
+                    "pad_token_id": 11,
+                },
+            },
+        }
+    )
+    trainer = get_trainer("PPOTrainer")(config)
+    init_state = jax.device_get(trainer.state)
+    _push_rollouts(trainer, rows=8)
+    mb = trainer.buffer.gather(np.arange(8), sharding=trainer._batch_sh)
+
+    # --- parity canary: health-on step vs health-off step, same bytes ---
+    step_jit_on = trainer._train_step_jit
+    state_on, stats_on = step_jit_on(
+        jax.device_put(init_state, trainer.state_shardings), mb
+    )
+    p_on, stats_on = jax.device_get((state_on.params, stats_on))
+    # flip the flag and rebuild — the same mechanism a health-off
+    # construction uses, minus the model/optimizer re-init
+    trainer._health_enabled = False
+    trainer._build_jitted_fns()
+    state_off, stats_off = trainer._train_step_jit(
+        jax.device_put(init_state, trainer.state_shardings), mb
+    )
+    p_off = jax.device_get(state_off.params)
+    assert not any(k.startswith("health/") for k in stats_off)
+    for key in (
+        "health/entropy",
+        "health/log_ratio_max",
+        "health/value_explained_var",
+        "health/reward_q50",
+    ):
+        assert key in stats_on, key
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_on),
+        jax.tree_util.tree_leaves(p_off),
+        strict=True,
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # --- transfer count: restore the COMPILED health-on step (no
+    # rebuild: its jit cache is reused) and run the stepwise loop ---
+    trainer._health_enabled = True
+    trainer._train_step_jit = step_jit_on
+    trainer.buffer.clear_history()
+    _push_rollouts(trainer, rows=16)
+    monkeypatch.setattr(trainer, "save", lambda *a, **k: None)
+    counter = _CountingDeviceGet(monkeypatch)
+    final_stats = trainer.learn()
+    # 2 minibatches x 1 ppo_epoch = 2 update steps, each a log step:
+    # exactly one fetch per step, nothing else transferred
+    assert counter.count == 2
+    # the health scalars rode those fetches
+    for key in (
+        "health/entropy",
+        "health/log_ratio_max",
+        "health/value_explained_var",
+        "health/reward_std",
+    ):
+        assert key in final_stats, key
+    # and the detectors observed every fetched row without extra traffic
+    assert trainer.health_monitor.latest["health/entropy"] > 0.0
+
+
+@pytest.mark.slow
+def test_ilql_one_transfer_per_chunk_with_health(monkeypatch, tmp_path):
+    """The ILQL fused-chunk loop's stats+step fetch stays ONE device_get
+    per chunk with the health scalars riding it. Nightly tier (a full
+    ILQL trainer build; ROADMAP tier-1 budget note) — the tier-1 canary
+    for the transfer discipline is the stepwise PPO pin above, which
+    runs the same observe/record wiring."""
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_orchestrator, get_trainer
+
+    config = TRLConfig.from_dict(
+        {
+            "model": {"model_type": "gpt2", "model_arch": _tiny_arch()},
+            "train": {
+                "seq_length": 8,
+                "batch_size": 8,
+                "epochs": 1,
+                "total_steps": 2,
+                "log_interval": 1,
+                "eval_interval": 1000,
+                "checkpoint_interval": 10000,
+                "trainer": "ILQLTrainer",
+                "orchestrator": "OfflineOrchestrator",
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "float32",
+                "health": {"enabled": True, "dump_dir": str(tmp_path)},
+            },
+            "method": {
+                "name": "ILQLConfig",
+                "gen_kwargs": {
+                    "max_new_tokens": 3,
+                    "eos_token_id": 10,
+                    "pad_token_id": 11,
+                },
+            },
+        }
+    )
+    trainer = get_trainer("ILQLTrainer")(config)
+    orch = get_orchestrator("OfflineOrchestrator")(trainer)
+    samples = [([1, 2, 3, 4, 5], 1) for _ in range(16)]
+    rewards = list(np.linspace(-1.0, 1.0, 16))
+    orch.make_experience(samples, rewards)
+    monkeypatch.setattr(trainer, "save", lambda *a, **k: None)
+    counter = _CountingDeviceGet(monkeypatch)
+    final_stats = trainer.learn()
+    # total_steps=2 = one fused chunk of 2 updates: ONE batched fetch
+    # (stacked stats + step counter together)
+    assert counter.count == 1
+    for key in ("health/entropy", "health/q_max", "health/td_error_mean"):
+        assert key in final_stats, key
+
+
+# --------------------- end-to-end planted anomaly --------------------- #
+
+
+@pytest.mark.slow
+def test_health_smoke_end_to_end(tmp_path):
+    """The full --health-smoke flow (the CI job runs this same check via
+    the CLI per PR): clean phases quiet, poisoned embeddings trip
+    kl-spike + entropy-collapse, the on_error=dump policy writes a
+    flight dump, and --inspect renders it."""
+    from trlx_tpu.analysis.health_smoke import run_health_smoke
+
+    summary = run_health_smoke(dump_dir=str(tmp_path))
+    assert summary["clean_events"] == []
+    assert summary["missing_required"] == []
+    assert summary["tripped"]["kl-spike"] >= 1
+    assert summary["tripped"]["entropy-collapse"] >= 1
+    assert summary["dump"] and os.path.exists(summary["dump"])
+    assert summary["inspect_ok"]
+    assert summary["passed"]

@@ -103,16 +103,92 @@ def test_chrome_trace_export_roundtrip(tmp_path):
         with tracer.span("collect/decode"):
             pass
     jsonl = str(tmp_path / "spans.jsonl")
-    assert export_chrome_jsonl(jsonl, tracer.spans()) == 2
+    # 2 complete events + 2 metadata name events (process + one thread)
+    assert export_chrome_jsonl(jsonl, tracer.spans()) == 4
     events = [json.loads(line) for line in open(jsonl) if line.strip()]
-    assert {e["name"] for e in events} == {"phase/collect", "collect/decode"}
-    for e in events:
-        assert e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
+    complete = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {
+        "phase/collect", "collect/decode",
+    }
+    for e in complete:
+        assert e["dur"] >= 0 and "ts" in e
     # the array wrapper loads as plain JSON (chrome://tracing / Perfetto)
     wrapped = str(tmp_path / "trace.json")
-    assert chrome_trace_from_jsonl(jsonl, wrapped) == 2
+    assert chrome_trace_from_jsonl(jsonl, wrapped) == 4
     doc = json.load(open(wrapped))
-    assert len(doc["traceEvents"]) == 2
+    assert len(doc["traceEvents"]) == 4
+
+
+def test_chrome_trace_metadata_names_threads(tmp_path):
+    """The exporter emits chrome `metadata` name events so Perfetto
+    tracks carry REAL thread names (main loop vs background writer)
+    instead of bare integer tids — and nothing when there are no
+    spans."""
+    import threading
+
+    from trlx_tpu.telemetry import chrome_trace_events, export_chrome_jsonl
+
+    tracer = _fresh_tracer()
+    with tracer.span("phase/collect"):
+        pass
+
+    def worker():
+        with tracer.span("writer/flush"):
+            pass
+
+    t = threading.Thread(target=worker, name="rollout-writer")
+    t.start()
+    t.join()
+
+    events = chrome_trace_events(tracer.spans())
+    meta = [e for e in events if e["ph"] == "M"]
+    complete = [e for e in events if e["ph"] == "X"]
+    # metadata precedes complete events: process_name + 2 thread_names
+    assert [e["ph"] for e in events[: len(meta)]] == ["M"] * len(meta)
+    assert len(complete) == 2
+    proc = [e for e in meta if e["name"] == "process_name"]
+    assert len(proc) == 1 and proc[0]["args"]["name"] == "trlx_tpu"
+    thread_meta = {
+        e["tid"]: e["args"]["name"]
+        for e in meta
+        if e["name"] == "thread_name"
+    }
+    writer_span = tracer.last("writer/flush")
+    main_span = tracer.last("phase/collect")
+    assert thread_meta[writer_span.thread_id] == "rollout-writer"
+    assert thread_meta[main_span.thread_id] == threading.current_thread().name
+    # every complete event's tid has a name event
+    assert {e["tid"] for e in complete} <= set(thread_meta)
+    # no spans -> no events at all (not a lone metadata header)
+    assert chrome_trace_events([]) == []
+    jsonl = str(tmp_path / "empty.jsonl")
+    assert export_chrome_jsonl(jsonl, []) == 0
+    assert not os.path.exists(jsonl)
+
+
+def test_warn_on_span_drops_once(capsys):
+    """Nonzero ring evictions warn exactly once on stderr and the count
+    is returned for the bench payload — silent drops skew p50s."""
+    from trlx_tpu import telemetry
+
+    telemetry._drops_warned = False
+    clean = _fresh_tracer(max_records=8)
+    with clean.span("a"):
+        pass
+    assert telemetry.warn_on_span_drops(clean) == 0
+    assert capsys.readouterr().err == ""
+
+    tracer = _fresh_tracer(max_records=2)
+    for i in range(5):
+        with tracer.span(f"s{i}"):
+            pass
+    assert telemetry.warn_on_span_drops(tracer) == 3
+    err = capsys.readouterr().err
+    assert "dropped 3 spans" in err
+    # second call still returns the count but stays quiet
+    assert telemetry.warn_on_span_drops(tracer) == 3
+    assert capsys.readouterr().err == ""
+    telemetry._drops_warned = False
 
 
 def test_scoped_tracer_isolates_and_restores_global_history():

@@ -98,6 +98,23 @@ def main(argv=None) -> int:
         "regression trips the perf-regression gate",
     )
     parser.add_argument(
+        "--health-smoke",
+        action="store_true",
+        help="instead of the rule engines: planted-anomaly self-check "
+        "for the run-health detectors (docs/observability.md) — clean "
+        "streamed phases must stay quiet, then a poisoned embedding "
+        "table must trip kl-spike + entropy-collapse and write a "
+        "flight dump parseable by `python -m trlx_tpu.telemetry "
+        "--inspect`; exit 1 when any leg fails",
+    )
+    parser.add_argument(
+        "--health-dump-dir",
+        metavar="DIR",
+        default=None,
+        help="with --health-smoke: directory for the flight-dump "
+        "artifact (default: a temp dir; CI passes an upload path)",
+    )
+    parser.add_argument(
         "--update-budgets",
         action="store_true",
         help="with --resources / --compile-audit / --perf-audit: "
@@ -215,6 +232,22 @@ def main(argv=None) -> int:
             # partial relock) and nothing was written
             return 1 if report.findings else 0
         return report.exit_code(strict=args.strict)
+
+    if args.health_smoke:
+        _force_cpu_platform()
+        import json as _json
+
+        from trlx_tpu.analysis.health_smoke import (
+            format_smoke_text,
+            run_health_smoke,
+        )
+
+        summary = run_health_smoke(dump_dir=args.health_dump_dir)
+        if args.json:
+            print(_json.dumps(summary, default=str))
+        else:
+            print(format_smoke_text(summary))
+        return 0 if summary["passed"] else 1
 
     if args.perf_audit:
         _force_cpu_platform()

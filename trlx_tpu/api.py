@@ -114,7 +114,12 @@ def train(
         # and would silently drop the error on a successful run)
         try:
             trainer.learn()
-        except BaseException:
+        except BaseException as e:
+            # crash forensics for failures that escape learn()'s own
+            # epilogue (e.g. a collect failure re-raised after the
+            # stream abort): at most one flight dump per run — a no-op
+            # when learn() already dumped or health is off
+            trainer.flight_dump_on_exception(e)
             orch.close(reraise=False)
             raise
         orch.close()

@@ -136,6 +136,22 @@ class TrainConfig:
     # of silently training on NaNs. Checked wherever stats already cross to
     # host (every fused pass / ILQL chunk; log steps on the stepwise path).
     detect_anomalies: bool = True
+    # Run-health monitoring (telemetry/health.py, docs/observability.md):
+    # {"enabled": true, "on_error": "warn"|"dump"|"abort", "window": ...,
+    #  "detectors": {"kl-spike": {"zmax": ...}, ...}, "disable": [...]}.
+    # With enabled, each trainer's jitted step fuses training-dynamics
+    # scalars (entropy at ent_coef=0, log-ratio extremes, value explained
+    # variance, reward quantiles) into its stats pytree — riding the
+    # existing per-step transfer — and streaming detectors (kl-spike,
+    # entropy-collapse, ratio-explosion, grad-spike, reward-saturation,
+    # nan-precursor) watch the fetched rows on host. Bitwise-inert on
+    # training (tests/test_phase_overlap.py). Default off: the jitted
+    # programs stay byte-identical to a pre-health build.
+    health: Dict[str, Any] = field(default_factory=dict)
+    # dump one flight-recorder forensics JSON (telemetry/flight_recorder.py)
+    # at the END of exactly phase N, on demand — crash dumps need no flag;
+    # requires health.enabled
+    flight_dump_phase: Optional[int] = None
     project_name: str = "trlx_tpu"
     run_name: str = ""
     seed: int = 1000

@@ -87,8 +87,16 @@ def ilql_loss(
     vs: jax.Array,  # [B, S] state values
     batch: ILQLBatch,
     config: ILQLConfig,
+    health: bool = False,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Reference `ilql_models.py:52-116`, masked for static-shape padding."""
+    """Reference `ilql_models.py:52-116`, masked for static-shape padding.
+
+    ``health`` (``train.health.enabled``) fuses the Q-learning
+    training-dynamics scalars into the stats dict — policy entropy over
+    real tokens (entropy-collapse series), the masked Q extreme and TD
+    error (value blow-up precursors). Extra outputs only: the loss
+    arithmetic is untouched, so enabling health is bitwise-inert and
+    the scalars ride the chunk's existing single stats transfer."""
     B, T, V = logits.shape
     A = batch.actions_ixs.shape[1]
 
@@ -159,6 +167,23 @@ def ilql_loss(
         "values/q_mean": jnp.sum(Q[0] * terminal_mask) / n_nonterminal,
         "values/v_mean": jnp.sum(V_cur * terminal_mask) / n_nonterminal,
     }
+    if health:
+        # policy entropy over real next-token positions (the shared
+        # helper, on the LM logits the AWAC term already computes CE
+        # from)
+        from trlx_tpu.ops.ppo_math import policy_entropy
+
+        ent = policy_entropy(logits[:, :-1])
+        n_attn = jnp.maximum(jnp.sum(attn), 1.0)
+        stats["health/entropy"] = jnp.sum(ent * attn) / n_attn
+        # finite fill (never ±inf: the fetched value feeds EWMA state);
+        # >= 1 real action per batch is guaranteed by construction
+        stats["health/q_max"] = jnp.max(
+            jnp.where(terminal_mask > 0, Q[0], -1e30)
+        )
+        stats["health/td_error_mean"] = (
+            jnp.sum(jnp.abs(Q[0] - Q_target) * terminal_mask) / n_nonterminal
+        )
     return loss, stats
 
 

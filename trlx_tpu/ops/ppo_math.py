@@ -74,6 +74,16 @@ class PPOConfig(MethodConfig):
     )
 
 
+def policy_entropy(logits: jax.Array) -> jax.Array:
+    """Per-position policy entropy H = logsumexp(l) - sum softmax(l)*l,
+    with f32 accumulation. The ONE definition shared by the PPO
+    trainers (entropy bonus + health stats) and ``ilql_loss``'s health
+    entropy — a precision/masking fix here reaches every consumer."""
+    l = logits.astype(jnp.float32)
+    p = jax.nn.softmax(l, axis=-1)
+    return jax.scipy.special.logsumexp(l, axis=-1) - jnp.sum(p * l, axis=-1)
+
+
 def group_whiten(values, group_size: int):
     """Normalize within contiguous groups of ``group_size``:
     (v - group_mean) / (group_std + 1e-6). Works on host numpy arrays and
@@ -140,6 +150,8 @@ def ppo_loss(
     vf_coef: float,
     ent_coef: float = 0.0,
     entropy: Optional[jax.Array] = None,  # [B, R] per-position policy entropy
+    health: bool = False,
+    health_ev: bool = True,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Clipped-surrogate PPO loss (reference `ppo_models.py:141-199`).
 
@@ -150,6 +162,16 @@ def ppo_loss(
     the reference has none): ``loss -= ent_coef * mean(entropy)``. Sparse
     terminal-reward tasks (randomwalks) can collapse into low-entropy local
     optima without it.
+
+    ``health`` (``train.health.enabled``) fuses the training-dynamics
+    scalars the run-health detectors consume into the stats dict —
+    ``health/entropy`` (also meaningful at ``ent_coef=0``),
+    ``health/log_ratio_max|min`` (ratio-explosion precursors), and the
+    value-function explained variance (skipped when ``health_ev`` is
+    False — GRPO's returns slot carries a placeholder). Pure extra
+    *outputs*: nothing feeds back into the loss, so enabling health is
+    bitwise-inert on training (pinned in tests/test_phase_overlap.py),
+    and the scalars ride the step's existing stats transfer.
     """
     mask = mask.astype(values.dtype)
     n = jnp.maximum(jnp.sum(mask), 1.0)
@@ -179,8 +201,11 @@ def ppo_loss(
 
     loss = pg_loss + vf_coef * vf_loss
     mean_entropy = jnp.zeros(())
-    if ent_coef and entropy is not None:
+    if entropy is not None:
+        # also computed for the health stats at ent_coef=0; only the
+        # bonus term below touches the loss
         mean_entropy = jnp.sum(entropy * mask) / n
+    if ent_coef and entropy is not None:
         loss = loss - ent_coef * mean_entropy
 
     stats = {
@@ -196,7 +221,51 @@ def ppo_loss(
         "returns/mean": masked_mean(returns, mask),
         "advantages/mean": masked_mean(advantages, mask),
     }
+    if health:
+        maskb = mask > 0
+        if entropy is not None:
+            stats["health/entropy"] = mean_entropy
+        # masked extremes via finite fills (never ±inf: the fetched row
+        # feeds EWMA state and the nan-precursor rule); >= 1 real token
+        # per row is guaranteed by the response-budget check
+        stats["health/log_ratio_max"] = jnp.max(
+            jnp.where(maskb, log_ratio, -1e30)
+        )
+        stats["health/log_ratio_min"] = jnp.min(
+            jnp.where(maskb, log_ratio, 1e30)
+        )
+        if health_ev:
+            ret_mean = jnp.sum(returns * mask) / n
+            err = returns - values
+            err_mean = jnp.sum(err * mask) / n
+            var_ret = jnp.sum(((returns - ret_mean) ** 2) * mask) / n
+            var_err = jnp.sum(((err - err_mean) ** 2) * mask) / n
+            stats["health/value_explained_var"] = 1.0 - var_err / jnp.maximum(
+                var_ret, 1e-8
+            )
     return loss, stats
+
+
+def reward_health_stats(
+    rewards: jax.Array,  # [B, R] per-token shaped rewards
+    mask: jax.Array,  # [B, R]
+) -> Dict[str, jax.Array]:
+    """Per-sequence shaped-return distribution for the health stats
+    pytree: mean/std plus q10/q50/q90 quantiles over the batch's
+    KL-shaped returns. Device-side, riding the step's existing stats
+    transfer; a collapsed ``reward_std`` is the reward-saturation
+    detector's series. (For GRPO the rewards slot already holds
+    group-whitened advantages — the quantiles then describe the
+    advantage distribution, which is what its updates actually see.)"""
+    seq = jnp.sum(rewards * mask.astype(rewards.dtype), axis=1)
+    q = jnp.quantile(seq, jnp.asarray([0.1, 0.5, 0.9], seq.dtype))
+    return {
+        "health/reward_mean": jnp.mean(seq),
+        "health/reward_std": jnp.std(seq),
+        "health/reward_q10": q[0],
+        "health/reward_q50": q[1],
+        "health/reward_q90": q[2],
+    }
 
 
 # --- KL controllers (pure-state versions of `ppo_models.py:26-58`) ---

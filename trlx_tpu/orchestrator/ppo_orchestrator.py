@@ -337,6 +337,18 @@ class PPOOrchestrator(Orchestrator):
                 "policy/mean_rollout_kl": self.trainer.mean_kl,
             }
         )
+        # run-health: the collect stats row feeds the detectors too —
+        # exp/score_std is the reward-saturation series. Host floats
+        # only; the device-resident mean_rollout_kl scalar is skipped by
+        # the monitor (never forced) and observed later from the phase's
+        # fetched update rows.
+        observe = getattr(self.trainer, "observe_health", None)
+        if observe is not None:
+            observe(
+                stats,
+                step=iter_count,
+                phase=getattr(self.trainer, "health_phase_id", None),
+            )
         if getattr(self.trainer, "logger", None) is not None:
             self.trainer.logger.log(stats, step=iter_count)
         return stats

@@ -14,6 +14,15 @@ cost before a run; this package watches the run itself:
 - :mod:`trlx_tpu.telemetry.profiler` — programmatic ``jax.profiler``
   windows: ``train.profile_phase: N`` dumps one xplane trace for
   exactly phase N.
+- :mod:`trlx_tpu.telemetry.health` — run-health monitoring: streaming
+  training-dynamics detectors (kl-spike, entropy-collapse,
+  ratio-explosion, grad-spike, reward-saturation, nan-precursor) over
+  the per-update stats rows, enabled by ``train.health``.
+- :mod:`trlx_tpu.telemetry.flight_recorder` — crash forensics: a
+  bounded ring of phase records dumped as one JSON file on uncaught
+  exceptions / detector policy / ``train.flight_dump_phase``;
+  ``python -m trlx_tpu.telemetry --inspect <dump>`` renders the
+  triage view.
 
 Engine 10 (``python -m trlx_tpu.analysis --perf-audit``) gates the
 span durations against the ``perf_budgets`` section of
@@ -56,6 +65,7 @@ __all__ = [
     "quantile",
     "scoped_tracer",
     "span",
+    "warn_on_span_drops",
 ]
 
 _tracer: Optional[Tracer] = None
@@ -110,6 +120,32 @@ def scoped_tracer(tracer: Optional[Tracer] = None):
         yield installed
     finally:
         _tracer = prev
+
+
+_drops_warned = False
+
+
+def warn_on_span_drops(tracer: Optional[Tracer] = None) -> int:
+    """Return the tracer's ``dropped`` count, warning ONCE on stderr
+    when it is nonzero. Silent ring evictions skew every per-name p50
+    (the oldest — often slowest, compile-bearing — spans vanish first),
+    so any consumer aggregating span stats for a report should surface
+    this; bench.py ships the count in its payload and calls this."""
+    global _drops_warned
+    t = tracer if tracer is not None else get_tracer()
+    dropped = int(t.dropped)
+    if dropped and not _drops_warned:
+        import sys
+
+        print(
+            f"warning: span ring dropped {dropped} spans (oldest "
+            "evicted) — per-name p50/p95 stats cover a truncated "
+            "window; raise the ring with "
+            "telemetry.configure(max_records=...)",
+            file=sys.stderr,
+        )
+        _drops_warned = True
+    return dropped
 
 
 def configure(

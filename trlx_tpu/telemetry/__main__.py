@@ -1,0 +1,69 @@
+"""CLI: ``python -m trlx_tpu.telemetry --inspect <dump.json>``.
+
+Renders a flight-recorder forensics dump (docs/observability.md,
+"Flight recorder") as the human triage view: run header + error, the
+tripped-detector table, the last-good-phase stats diff, and span p50
+deltas. ``--json`` re-emits a machine-readable summary instead.
+
+Exit status: 0 on a parseable dump, 2 on an unreadable/incompatible
+file. (The dump's *content* never affects the exit code — this is a
+viewer, not a gate.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.telemetry",
+        description="inspect run-health flight-recorder dumps",
+    )
+    parser.add_argument(
+        "--inspect",
+        metavar="DUMP",
+        required=True,
+        help="path to a flight-recorder JSON dump",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit a machine-readable summary instead of the triage view",
+    )
+    args = parser.parse_args(argv)
+
+    from trlx_tpu.telemetry.flight_recorder import inspect_dump, load_dump
+
+    try:
+        payload = load_dump(args.inspect)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: cannot read {args.inspect}: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        events = payload.get("events") or []
+        counts: dict = {}
+        for e in events:
+            det = e.get("detector", "?")
+            counts[det] = counts.get(det, 0) + 1
+        print(
+            json.dumps(
+                {
+                    "reason": payload.get("reason"),
+                    "fingerprint": payload.get("fingerprint"),
+                    "error": payload.get("error"),
+                    "phases_recorded": len(payload.get("phases") or []),
+                    "event_counts": counts,
+                }
+            )
+        )
+    else:
+        print(inspect_dump(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,7 +80,7 @@ class Span:
 
     __slots__ = (
         "name", "attrs", "start", "end", "status",
-        "index", "parent", "depth", "thread_id", "_tracer",
+        "index", "parent", "depth", "thread_id", "thread_name", "_tracer",
     )
 
     def __init__(
@@ -98,6 +98,7 @@ class Span:
         self.parent: Optional[int] = None
         self.depth = 0
         self.thread_id = 0
+        self.thread_name = ""
         self._tracer = tracer  # None: forced-but-unrecorded span
 
     @property
@@ -239,7 +240,9 @@ class Tracer:
             self._next_index += 1
         span.parent = stack[-1].index if stack else None
         span.depth = len(stack)
-        span.thread_id = threading.get_ident()
+        thread = threading.current_thread()
+        span.thread_id = thread.ident or 0
+        span.thread_name = thread.name
         stack.append(span)
 
     def _close(self, span: Span) -> None:
@@ -269,20 +272,50 @@ def quantile(sorted_durs: Sequence[float], q: float) -> float:
 def chrome_trace_events(spans: Iterable[Span]) -> List[Dict[str, Any]]:
     """Spans as chrome-tracing "complete" (``ph: X``) events: ``ts`` /
     ``dur`` in microseconds on the shared monotonic timebase, ``tid`` =
-    the opening thread, span attrs + status under ``args``."""
+    the opening thread, span attrs + status under ``args``.
+
+    Prepends chrome ``metadata`` (``ph: M``) name events — one
+    ``process_name`` plus a ``thread_name`` per distinct tid — so
+    Perfetto/chrome:tracing label the tracks with real thread names
+    (main loop vs the background writer) instead of bare integer tids."""
     pid = os.getpid()
-    return [
+    complete = []
+    tid_names: Dict[int, str] = {}
+    for s in spans:
+        name = getattr(s, "thread_name", "") or f"tid-{s.thread_id}"
+        tid_names.setdefault(s.thread_id, name)
+        complete.append(
+            {
+                "name": s.name,
+                "ph": "X",
+                "ts": round(s.start * 1e6, 3),
+                "dur": round((s.end - s.start) * 1e6, 3),
+                "pid": pid,
+                "tid": s.thread_id,
+                "args": {**s.attrs, "status": s.status, "depth": s.depth},
+            }
+        )
+    if not complete:
+        return []
+    meta: List[Dict[str, Any]] = [
         {
-            "name": s.name,
-            "ph": "X",
-            "ts": round(s.start * 1e6, 3),
-            "dur": round((s.end - s.start) * 1e6, 3),
+            "name": "process_name",
+            "ph": "M",
             "pid": pid,
-            "tid": s.thread_id,
-            "args": {**s.attrs, "status": s.status, "depth": s.depth},
+            "args": {"name": "trlx_tpu"},
         }
-        for s in spans
     ]
+    for tid, name in sorted(tid_names.items()):
+        meta.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": name},
+            }
+        )
+    return meta + complete
 
 
 def export_chrome_jsonl(path: str, spans: Iterable[Span], writer=None) -> int:

@@ -68,6 +68,212 @@ class BaseRLTrainer(ABC):
         self.logit_mask = logit_mask
         self.orch = None  # back-reference installed by the orchestrator
         self.eval_pipeline = None
+        self._setup_health()
+
+    def _setup_health(self) -> None:
+        """Run-health monitoring (telemetry/health.py): parse
+        ``train.health``, and — when enabled, on the main process only
+        (a per-host abort decision would desynchronize the collective
+        schedule, the host-branch hazard) — build the detector monitor
+        and the crash-forensics flight recorder. ``_health_enabled``
+        additionally gates the fused device-side health scalars in the
+        jitted steps, so it is set before any program is built."""
+        from trlx_tpu.telemetry.health import HealthConfig
+
+        self.health_config = HealthConfig.from_dict(self.config.train.health)
+        self._health_enabled = bool(self.health_config.enabled)
+        self._health_ev = True  # GRPO opts out (placeholder returns slot)
+        self.health_monitor = None
+        self.flight_recorder = None
+        if not self._health_enabled:
+            return
+        from trlx_tpu.parallel.distributed import is_main_process
+
+        if not is_main_process():
+            return
+        from trlx_tpu.telemetry.flight_recorder import FlightRecorder
+        from trlx_tpu.telemetry.health import (
+            HealthMonitor,
+            config_fingerprint,
+        )
+
+        config_dict = self.config.to_dict()
+        fingerprint = config_fingerprint(config_dict)
+        self.health_monitor = HealthMonitor(self.health_config, fingerprint)
+        self.flight_recorder = FlightRecorder(
+            capacity=self.health_config.flight_capacity,
+            directory=self.health_config.dump_dir,
+            fingerprint=fingerprint,
+            config=config_dict,
+        )
+
+    def observe_health(
+        self,
+        row: Dict[str, Any],
+        step: Optional[int] = None,
+        phase: Optional[int] = None,
+    ) -> None:
+        """Feed one already-fetched stats row to the detector engine.
+
+        Called wherever rows cross to host anyway (the streamed phase
+        epilogue, the fused pass, log steps on the stepwise path, ILQL
+        chunks, the orchestrator's collect stats) — the monitor never
+        forces a device transfer; ``jax.Array`` leaves are skipped and
+        observed later from the row they are fetched into. Each trip
+        lands in the span stream and the Logger; ``error`` trips apply
+        the ``health.on_error`` policy (warn | dump | abort)."""
+        monitor = self.health_monitor
+        if monitor is None:
+            return
+        from trlx_tpu import telemetry
+
+        events = monitor.observe(row, step=step, phase=phase)
+        if not events:
+            return
+        logger = getattr(self, "logger", None)
+        for ev in events:
+            # zero-length marker span: the trip shows on the trace
+            # timeline next to the phase whose stats produced it
+            with telemetry.span(
+                "health/" + ev.detector,
+                severity=ev.severity,
+                series=ev.series,
+                step=ev.step,
+            ):
+                pass
+            if logger is not None:
+                logger.log_health_event(ev.to_dict(), step=ev.step)
+            else:
+                print(f"health: {ev.severity} {ev.detector}: {ev.message}",
+                      file=sys.stderr)
+        errors = [ev for ev in events if ev.severity == "error"]
+        policy = self.health_config.on_error
+        if not errors or policy == "warn":
+            return
+        recorder = self.flight_recorder
+        if recorder is not None:
+            # land the OFFENDING row + its events in the ring before
+            # dumping, so the forensics file's final phase record and
+            # its last-good diff show the anomaly itself — the phase
+            # epilogue's own record has not run yet at this point.
+            # Guarded: under the record-and-continue `dump` policy a
+            # failing forensics write (full disk, unserializable config)
+            # must never kill an otherwise-continuable run
+            try:
+                recorder.record_phase(
+                    phase,
+                    step=errors[0].step,
+                    stats_row=row,
+                    events=events,
+                    detector_state=monitor.state_summary(),
+                )
+                for ev in errors:
+                    path = recorder.dump(
+                        "detector:" + ev.detector, once=True
+                    )
+                    if path:
+                        print(f"health: flight record dumped to {path}",
+                              file=sys.stderr)
+            except Exception as dump_err:
+                print(
+                    f"health: flight dump FAILED "
+                    f"({type(dump_err).__name__}: {dump_err})",
+                    file=sys.stderr,
+                )
+        if policy == "abort":
+            from trlx_tpu.telemetry.health import HealthAbort
+
+            first = errors[0]
+            raise HealthAbort(
+                f"health.on_error=abort: detector {first.detector!r} "
+                f"tripped at step {first.step} ({first.message}); "
+                f"flight record(s): {self.flight_recorder.dumped if self.flight_recorder else 'disabled'}"
+            )
+
+    def observe_health_rows(
+        self,
+        rows: Dict[str, Any],
+        step0: Optional[int] = None,
+        phase: Optional[int] = None,
+        phase_row: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Feed a fetched stacked-stats tree (each value an [n_updates]
+        host array) to the detectors row by row, then ``phase_row`` —
+        series that are constant across the phase's rows (the rollout
+        KL) — exactly ONCE. Repeating a phase-constant value per row
+        would collapse its EWMA variance and burn warmup/cooldown in
+        row units, hair-triggering the z-score rules on ordinary
+        phase-to-phase movement. Returns the last row (+ phase_row)
+        for the flight record."""
+        last: Dict[str, Any] = {}
+        if self.health_monitor is None or not rows:
+            return last
+        n_rows = len(rows[next(iter(rows))])
+        for r in range(n_rows):
+            last = {key: float(v[r]) for key, v in rows.items()}
+            self.observe_health(
+                last,
+                step=None if step0 is None else step0 + r + 1,
+                phase=phase,
+            )
+        if phase_row:
+            self.observe_health(phase_row, phase=phase)
+            last = {**last, **phase_row}
+        return last
+
+    def record_flight_phase(
+        self,
+        phase: Optional[int],
+        step: Optional[int] = None,
+        stats_row: Optional[Dict[str, Any]] = None,
+        kl_seq: Optional[List[float]] = None,
+    ) -> None:
+        """Append one phase record to the flight ring (no-op when health
+        is off) and honor the on-demand ``train.flight_dump_phase``."""
+        recorder = self.flight_recorder
+        if recorder is None:
+            return
+        monitor = self.health_monitor
+        recorder.record_phase(
+            phase,
+            step=step,
+            stats_row=stats_row,
+            kl_seq=kl_seq,
+            events=monitor.recent_events(phase) if monitor else (),
+            detector_state=monitor.state_summary() if monitor else None,
+        )
+        want = self.config.train.flight_dump_phase
+        if want is not None and phase == want:
+            path = recorder.dump(f"flight_dump_phase:{phase}", once=True)
+            if path:
+                print(f"health: flight record dumped to {path}",
+                      file=sys.stderr)
+
+    def flight_dump_on_exception(self, error: BaseException) -> None:
+        """learn()-epilogue hook: write the crash forensics file for an
+        uncaught exception (at most once per recorder; a HealthAbort
+        whose detector already dumped is not dumped again)."""
+        recorder = self.flight_recorder
+        if recorder is None:
+            return
+        try:
+            monitor = self.health_monitor
+            if monitor is not None and monitor.events:
+                # fold events the crash preempted out of a phase record
+                # (e.g. check_anomalies raising mid-epilogue) into the
+                # NEWEST record — never a fresh stats-less one, which
+                # would displace the real final phase from the
+                # --inspect last-good diff; the recorder dedupes, so
+                # repeats are safe
+                recorder.note_events(
+                    monitor.events,
+                    detector_state=monitor.state_summary(),
+                )
+            path = recorder.dump_on_exception(error)
+        except Exception:
+            return  # forensics must never mask the real failure
+        if path:
+            print(f"health: flight record dumped to {path}", file=sys.stderr)
 
     def add_eval_pipeline(self, pipeline) -> None:
         """Eval prompts source (reference `accelerate_base_model.py:148-150`)."""

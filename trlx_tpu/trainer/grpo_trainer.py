@@ -61,6 +61,13 @@ class GRPOMixin:
             )
         super().__init__(config, **kw)  # sets self.group_size (read by the
         # orchestrator to repeat prompts within each chunk)
+        # run-health: skip the value-explained-variance stat — GRPO's
+        # returns slot carries a placeholder (the stored rollout values),
+        # so EV would read as a perfect-fit ~0-residual artifact and
+        # mislead triage; the reward_* health quantiles stay on and
+        # describe the group-whitened advantage distribution the updates
+        # actually consume
+        self._health_ev = False
 
     def _shape_rewards(self, logprobs, ref_logprobs, response_mask, scores, kl_coef):
         """Store group-normalized per-sequence advantages (broadcast over

@@ -289,7 +289,8 @@ class ILQLTrainer(BaseRLTrainer):
                     method=CausalLMWithILQLHeads.target_qs,
                 )
                 loss, stats = ilql_loss(
-                    out["logits"], out["qs"], target_qs, out["vs"], mb, method
+                    out["logits"], out["qs"], target_qs, out["vs"], mb,
+                    method, health=self._health_enabled,
                 )
                 if moe_family:
                     # same Switch load-balancing objective as the PPO path
@@ -515,6 +516,11 @@ class ILQLTrainer(BaseRLTrainer):
         self.logger = logger
         try:
             return self._learn_body(logger, total_steps, n_minibatches)
+        except BaseException as e:
+            # crash forensics (telemetry/flight_recorder.py): no-op when
+            # health is off, at most one dump per run
+            self.flight_dump_on_exception(e)
+            raise
         finally:
             # single epilogue for every exit (incl. exceptions): join
             # in-flight async checkpoint writes, close the logger even if
@@ -532,6 +538,7 @@ class ILQLTrainer(BaseRLTrainer):
         logger.log(stats, step=0)
 
         clock = Clock()
+        self._chunk_index = -1  # flight-recorder "phase" = fused chunk
         iter_count = int(self.state.step)  # nonzero after resume
         if iter_count >= total_steps:
             self._final_stats = {}
@@ -570,6 +577,21 @@ class ILQLTrainer(BaseRLTrainer):
                 # the step counter — save() reuses the fetched step instead
                 # of paying its own device_get round-trip
                 rows, host_step = jax.device_get((stacked, self.state.step))
+                self._chunk_index += 1
+                if self.health_monitor is not None:
+                    # every fetched chunk row feeds the detectors — the
+                    # batched transfer above already paid; one flight
+                    # record per chunk (the ILQL "phase"). BEFORE
+                    # check_anomalies: a NaN chunk must reach the
+                    # nan-precursor trip + flight ring before the
+                    # anomaly abort raises
+                    hrow = self.observe_health_rows(
+                        rows, step0=iter_count, phase=self._chunk_index
+                    )
+                    self.record_flight_phase(
+                        self._chunk_index, step=iter_count + k,
+                        stats_row=hrow,
+                    )
                 self.check_anomalies(rows, iter_count)
                 for j in range(k):
                     iter_count += 1

@@ -45,7 +45,9 @@ from trlx_tpu.ops.ppo_math import (
     PPOConfig,
     get_advantages_and_returns,
     kl_controller_update,
+    policy_entropy,
     ppo_loss,
+    reward_health_stats,
 )
 from trlx_tpu.ops.sampling import (
     GenerationConfig,
@@ -113,11 +115,10 @@ def get_gpt2_arch(config: TRLConfig):
     return arch, params
 
 
-def _policy_entropy(logits: jax.Array) -> jax.Array:
-    """Per-position policy entropy H = logsumexp(l) - sum softmax(l) * l."""
-    l = logits.astype(jnp.float32)
-    p = jax.nn.softmax(l, axis=-1)
-    return jax.scipy.special.logsumexp(l, axis=-1) - jnp.sum(p * l, axis=-1)
+# canonical definition lives in ops/ppo_math.py (shared with ilql_loss);
+# the underscore alias keeps this module's historical import surface
+# (seq2seq_ppo_trainer imports it from here)
+_policy_entropy = policy_entropy
 
 
 class _StreamedPhase:
@@ -336,6 +337,12 @@ class PPOTrainer(BaseRLTrainer):
 
         self._phase_index = -1
         self._phase_profiler = PhaseProfiler(None, None)
+        # health/flight phase id for direct drivers of the phase API
+        # (bench, perf/health-smoke harnesses): learn() advances
+        # _phase_index via _collect_phase; outside learn it stays -1,
+        # so health_phase_id falls back to a counter bumped by
+        # begin_streamed_phase
+        self._health_phase = -1
 
         self.setup_ep_axis(self.mesh, self.family)
         # MoE families contribute router load-balancing losses to the
@@ -686,8 +693,13 @@ class PPOTrainer(BaseRLTrainer):
                 method=self.model.response_forward,
             )
         logprobs = logprobs_from_logits(logits, mb.response_tokens)
+        # entropy also under health (train.health.enabled) at ent_coef=0:
+        # the entropy-collapse detector needs the series; the loss only
+        # consumes it when the bonus coefficient is nonzero
         entropy = (
-            _policy_entropy(logits) if self.config.method.ent_coef else None
+            _policy_entropy(logits)
+            if (self.config.method.ent_coef or self._health_enabled)
+            else None
         )
         return logprobs, values.astype(jnp.float32), entropy, moe
 
@@ -892,6 +904,8 @@ class PPOTrainer(BaseRLTrainer):
                     method.vf_coef,
                     ent_coef=method.ent_coef,
                     entropy=entropy,
+                    health=self._health_enabled,
+                    health_ev=self._health_ev,
                 )
                 if moe is not None:
                     # Switch load-balancing: without this, top-1 routing
@@ -912,6 +926,14 @@ class PPOTrainer(BaseRLTrainer):
             )
             new_params = optax.apply_updates(state.params, updates)
             stats["optimizer/grad_norm"] = optax.global_norm(grads)
+            if self._health_enabled:
+                # shaped-return distribution next to the loss stats — a
+                # pure extra output riding the same transfer, so the
+                # one-transfer-per-update discipline holds (pinned in
+                # tests/test_health.py)
+                stats.update(
+                    reward_health_stats(mb.rewards, mb.response_mask)
+                )
             new_state = TrainState(
                 params=new_params, opt_state=new_opt_state, step=state.step + 1
             )
@@ -1060,6 +1082,17 @@ class PPOTrainer(BaseRLTrainer):
     # semantically identical to running the same plan serially — pinned
     # bitwise in tests/test_phase_overlap.py.
 
+    @property
+    def health_phase_id(self) -> int:
+        """Phase id health events and flight records are stamped with:
+        learn()'s phase counter when it is driving, else the
+        begin_streamed_phase fallback counter (direct drivers) — one
+        id per phase across the collect window and the epilogue."""
+        return (
+            self._phase_index if self._phase_index >= 0
+            else self._health_phase
+        )
+
     def begin_streamed_phase(
         self,
         seed: int = 0,
@@ -1087,6 +1120,10 @@ class PPOTrainer(BaseRLTrainer):
         if len(self.buffer):
             self.buffer.clear_history()
         self.buffer.begin_stream(plan.total)
+        # direct drivers (bench, harnesses) never advance _phase_index;
+        # bump the fallback health-phase id HERE so collect-window
+        # events and the phase's flight record agree on the id
+        self._health_phase += 1
         # the legacy lazy cast copy is dead weight once the snapshot exists
         self._rollout_params_cache = None
         self._behavior_params = self._behavior_snapshot_jit(self.state.params)
@@ -1239,6 +1276,33 @@ class PPOTrainer(BaseRLTrainer):
         self._last_overlap_stats.update(phase_memory_stats())
 
         self._stream = None
+
+        # run-health: feed every fetched update row to the detector
+        # engine in execution order, the phase-level rollout KL (the
+        # kl-spike series) once per phase, then append the phase's
+        # flight record. This lives HERE — not in _learn_body — so
+        # direct drivers of the phase API (bench, the perf/health-smoke
+        # harnesses) get monitoring without running learn(). Host
+        # floats only: the single batched fetch above already paid the
+        # transfer. The phase state is closed first so an `abort`
+        # policy raising out of observe_health leaves the trainer
+        # re-enterable.
+        if self.health_monitor is not None:
+            phase_id = self.health_phase_id
+            last_row: Dict[str, Any] = {}
+            try:
+                last_row = self.observe_health_rows(
+                    rows,
+                    phase=phase_id,
+                    phase_row={
+                        "policy/mean_rollout_kl": self._last_phase_mean_kl
+                    },
+                )
+            finally:
+                self.record_flight_phase(
+                    phase_id, stats_row=last_row, kl_seq=kl_seq
+                )
+
         return plan.n_updates, rows, kl_seq
 
     def _stream_eligible(self, iter_count: int) -> bool:
@@ -1364,6 +1428,12 @@ class PPOTrainer(BaseRLTrainer):
         self._profiling = False
         try:
             return self._learn_body(logger, total_steps, n_minibatches, start_step)
+        except BaseException as e:
+            # crash forensics: one flight dump per run on the way down
+            # (telemetry/flight_recorder.py; no-op when health is off,
+            # deduped when a HealthAbort's detector already dumped)
+            self.flight_dump_on_exception(e)
+            raise
         finally:
             # single epilogue for every exit (incl. exceptions): stop any
             # live profiler trace (legacy first-steps AND the single-phase
@@ -1521,6 +1591,18 @@ class PPOTrainer(BaseRLTrainer):
                         (stacked, kl_seq, self.mean_kl)
                     )
                 phase_time = clock.tick(train.batch_size) / 1000.0
+                # every fetched update row feeds the detectors (the
+                # streamed path does the same in finish_streamed_phase);
+                # the phase-constant rollout KL is observed once. BEFORE
+                # check_anomalies: on a NaN row the nan-precursor trip +
+                # flight-recorder policy must see the offending phase
+                # before the anomaly abort raises
+                self.observe_health_rows(
+                    rows,
+                    step0=iter_count,
+                    phase=self._phase_index,
+                    phase_row={"policy/mean_rollout_kl": float(mean_kl)},
+                )
                 self.check_anomalies(rows, iter_count)
                 step_stats = {}
                 for k in range(n_minibatches):
@@ -1534,6 +1616,10 @@ class PPOTrainer(BaseRLTrainer):
                     if iter_count % train.log_interval == 0:
                         logger.log(step_stats, step=iter_count)
                         final_stats = dict(step_stats)
+                self.record_flight_phase(
+                    self._phase_index, step=iter_count,
+                    stats_row=step_stats, kl_seq=list(kl_seq),
+                )
                 self._phase_profiler.on_phase_end(sync=self.state.params)
                 final_stats, done = self._end_of_pass(
                     logger, iter_count, total_steps, final_stats, epoch
@@ -1542,6 +1628,7 @@ class PPOTrainer(BaseRLTrainer):
                     return final_stats
                 continue
 
+            step_stats = {}
             for mb in self.buffer.create_loader(
                 train.batch_size,
                 shuffle=True,
@@ -1574,6 +1661,21 @@ class PPOTrainer(BaseRLTrainer):
                     # log and save branches each paying their own
                     # device_get doubled/tripled the host round-trips
                     step_stats = jax.device_get(step_stats)
+                    # detectors read the same fetched row — still the
+                    # one transfer this step already paid, and BEFORE
+                    # check_anomalies so a NaN row reaches nan-precursor
+                    # and the flight policy before the anomaly abort.
+                    # The rollout KL is phase-constant, so it is
+                    # excluded here and observed once at the pass
+                    # boundary below (per-row repeats would collapse
+                    # its EWMA variance)
+                    self.observe_health(
+                        {
+                            k: v for k, v in step_stats.items()
+                            if k != "policy/mean_rollout_kl"
+                        },
+                        step=iter_count, phase=self._phase_index,
+                    )
                     # never log or persist a NaN state
                     self.check_anomalies(step_stats, iter_count)
                 if iv["do_log"]:
@@ -1594,7 +1696,23 @@ class PPOTrainer(BaseRLTrainer):
                     final_stats.update(eval_stats)
                     self._final_stats = final_stats
                     return final_stats
-            # stepwise pass done — phase boundary for the profiler window
+            # stepwise pass done — phase boundary: the phase-level KL
+            # series gets its ONE observation (skipped by the monitor if
+            # the value never crossed to host this pass), then the
+            # flight record (device leaves in an unfetched last row are
+            # dropped by the recorder, never forced)
+            if self.health_monitor is not None and step_stats:
+                self.observe_health(
+                    {
+                        "policy/mean_rollout_kl": step_stats.get(
+                            "policy/mean_rollout_kl"
+                        )
+                    },
+                    step=iter_count, phase=self._phase_index,
+                )
+            self.record_flight_phase(
+                self._phase_index, step=iter_count, stats_row=step_stats
+            )
             self._phase_profiler.on_phase_end(sync=self.state.params)
             # on-policy refresh (post_epoch_callback,
             # `accelerate_ppo_model.py:130-134`)

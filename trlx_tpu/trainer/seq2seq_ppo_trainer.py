@@ -238,9 +238,11 @@ class Seq2SeqPPOTrainer(PPOTrainer):
                 decoder_attention_mask=dec_mask,
             )
         logprobs = logprobs_from_logits(out["logits"], mb.response_tokens)
+        # entropy also under health at ent_coef=0 (the entropy-collapse
+        # detector's series), same contract as the causal trainer
         entropy = (
             _policy_entropy(out["logits"])
-            if self.config.method.ent_coef
+            if (self.config.method.ent_coef or self._health_enabled)
             else None
         )
         # no MoE T5 family: the 4th slot (router losses) is always None

@@ -124,6 +124,33 @@ class Logger:
             self._pbar.set_postfix(postfix, refresh=False)
         self._pbar.refresh()
 
+    def log_health_event(
+        self, event: Dict[str, Any], step: Optional[int] = None
+    ) -> None:
+        """Emit one structured run-health event (telemetry/health.py) as
+        a ``health_event`` JSON line on the metrics stream — greppable
+        next to the stats rows that tripped it — plus a wandb counter
+        bump so dashboards can alert on trips without parsing stdout."""
+        if not self.is_main:
+            return
+        if self._pbar is not None:
+            self._pbar.clear()  # same terminal-sharing guard as log()
+        record = {
+            "step": step,
+            "time": round(monotonic() - self.start, 2),
+            "health_event": event,
+        }
+        print(json.dumps(record, default=float), file=self.stream, flush=True)
+        if self._wandb is not None:
+            try:
+                detector = event.get("detector", "unknown")
+                self._wandb.log(
+                    {f"health/event/{detector}": float(event.get("value", 1.0))},
+                    step=step,
+                )
+            except Exception:
+                pass
+
     def log_samples(self, rows, columns, step: Optional[int] = None) -> None:
         """Log generated-sample tables (reference wandb Table,
         `accelerate_base_model.py:180-221`); stdout shows the first rows."""
