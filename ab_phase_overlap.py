@@ -154,29 +154,15 @@ def main():
     print(json.dumps(record))
     # self-recording measurement (repo discipline: results live in
     # committed artifacts, not docstring TODOs): keep the latest record
-    # per (metric, device_kind), dated
-    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "AB_PHASE_OVERLAP.json")
-    try:
-        with open(artifact, encoding="utf-8") as fh:
-            history = json.load(fh)
-    except (OSError, ValueError):
-        history = []
-    if not isinstance(history, list) or not all(
-        isinstance(r, dict) for r in history
-    ):
-        # hand-edited/wrong-shaped artifact: start fresh rather than
-        # crash AFTER the measurement already ran
-        history = []
-    dated = dict(record, date=time.strftime("%Y-%m-%d"))
-    history = [
-        r for r in history
-        if (r.get("metric"), r.get("device_kind"))
-        != (record["metric"], record["device_kind"])
-    ] + [dated]
-    with open(artifact, "w", encoding="utf-8") as fh:
-        json.dump(history, fh, indent=2)
-        fh.write("\n")
+    # per (metric, device_kind), dated — shared helper, also used by
+    # ab_int8_kv.py
+    from trlx_tpu.utils.ab_record import record_latest
+
+    record_latest(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "AB_PHASE_OVERLAP.json"),
+        record,
+    )
 
 
 if __name__ == "__main__":

@@ -282,6 +282,13 @@ def _workload_config(num_layers_unfrozen, ref_branch_layers):
     """
     from trlx_tpu.data.configs import TRLConfig
 
+    # rollout engine selection (docs/inference.md): default stays the
+    # fixed-batch sampler so the r01-r05 series keeps comparing; set
+    # TRLX_BENCH_ROLLOUT_ENGINE=continuous to measure the slot-admission
+    # engine (the payload then carries collect/admit_ms + slot_util next
+    # to the phase tree)
+    rollout_engine = os.environ.get("TRLX_BENCH_ROLLOUT_ENGINE", "fixed")
+
     return TRLConfig.from_dict(
         {
             "model": {
@@ -327,6 +334,7 @@ def _workload_config(num_layers_unfrozen, ref_branch_layers):
                 # gate's harness keeps health off, so engine 10's
                 # lockfile is unaffected)
                 "health": {"enabled": True},
+                "rollout": {"engine": rollout_engine},
             },
             "method": {
                 "name": "PPOConfig",
@@ -570,9 +578,26 @@ def measure_throughput(config, n_phases=5):
         ("phase/collect", "phase/collect_ms"),
         ("phase/train", "phase/train_ms"),
         ("train/drain", "phase/drain_ms"),
+        # continuous-engine decode-loop spans (docs/inference.md):
+        # admission bookkeeping, prefill dispatch, harvest/recycle —
+        # present only when the engine ran this round
+        ("collect/admit", "collect/admit_ms"),
+        ("collect/prefill", "collect/prefill_ms"),
+        ("collect/slot_recycle", "collect/slot_recycle_ms"),
     ):
         if key in span_stats:
             out[flat] = round(span_stats[key]["p50_ms"], 1)
+    # slot-occupancy stats ride the payload next to the span tree when
+    # the continuous engine collected this round
+    if (
+        getattr(trainer, "rollout_engine", "fixed") == "continuous"
+        and getattr(trainer, "_rollout_engine_obj", None) is not None
+    ):
+        engine_stats = trainer._rollout_engine_obj.stats.to_dict()
+        # one canonical key for occupancy; the remaining engine/*
+        # counters keep their namespaced names
+        out["slot_util"] = engine_stats.pop("engine/slot_util")
+        out.update(engine_stats)
     out["spans"] = {
         name: {
             "count": int(s["count"]),

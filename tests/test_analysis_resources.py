@@ -298,7 +298,6 @@ def test_update_budgets_partial_merge_and_mesh_refusal(tmp_path):
     fx = merged["programs"]["fx.step"]
     assert fx["peak_hbm_bytes"] > 1  # relocked from the trace
     assert fx["tolerance_pct"] == 2.0  # override survives regeneration
-    assert merged["tolerance_pct"] == 7.5
 
     # subset trace on another mesh: refuse, write nothing
     report, _ = ra.audit_resources(
@@ -320,6 +319,50 @@ def test_update_budgets_partial_merge_and_mesh_refusal(tmp_path):
     assert set(full["programs"]) == {"fx.step"}
     assert full["programs"]["fx.step"]["tolerance_pct"] == 2.0
     assert full["tolerance_pct"] == 7.5
+    # the file-level tolerance override also survives the PARTIAL merge
+    # (re-check on the merged file from the subset relock above)
+    assert merged["tolerance_pct"] == 7.5
+
+
+def test_update_budgets_preserves_foreign_sections(tmp_path):
+    # a resource relock must pass OTHER engines' lockfile sections
+    # (compile_budgets, engine 8; perf_budgets, engine 10) through
+    # untouched — before this guard a `--resources --update-budgets`
+    # silently wiped them out of the shared lockfile
+    from types import SimpleNamespace
+
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.analysis import resource_audit as ra
+
+    path = str(tmp_path / "budgets.json")
+    x = jnp.zeros((4, 4), jnp.float32)
+    program = SimpleNamespace(
+        closed_jaxpr=jax.make_jaxpr(lambda x: x * 2.0)(x),
+        subject="fx.step", mesh_shape={"dp": 8},
+        input_divisors=None, def_site=None,
+    )
+    foreign_compile = {"mesh": {"dp": 8}, "programs": {"fx.step": {"compiles": 1}}}
+    foreign_perf = {"platforms": {"cpu": {"spans": {}}}}
+    ra.write_budgets({
+        "schema_version": ra.BUDGETS_SCHEMA_VERSION,
+        "mesh": {"dp": 8},
+        "tolerance_pct": 7.5,
+        "programs": {},
+        "compile_budgets": foreign_compile,
+        "perf_budgets": foreign_perf,
+    }, path)
+
+    report, _ = ra.audit_resources(
+        kinds=["fx"], budgets_path=path, update=True, programs=[program],
+    )
+    assert report.findings == []
+    merged = ra.load_budgets(path)
+    assert merged["compile_budgets"] == foreign_compile
+    assert merged["perf_budgets"] == foreign_perf
+    assert "fx.step" in merged["programs"]
+    assert merged["tolerance_pct"] == 7.5
 
 
 # ---------------------------- donation fixtures -------------------------- #

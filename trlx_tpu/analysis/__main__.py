@@ -158,6 +158,13 @@ def main(argv=None) -> int:
         "the streamed dispatcher produces it",
     )
     parser.add_argument(
+        "--engine-step",
+        action="store_true",
+        help="with --sanitize ppo: replay the continuous-batching "
+        "engine's decode_step (docs/inference.md) on a concretely "
+        "prefilled slot pool instead of the train step",
+    )
+    parser.add_argument(
         "--paths",
         nargs="*",
         default=None,
@@ -309,12 +316,20 @@ def main(argv=None) -> int:
 
     if args.sanitize:
         _force_cpu_platform()
-        from trlx_tpu.analysis.sanitizer import sanitize_trainer
-
-        result = sanitize_trainer(
-            args.sanitize, mesh=mesh, plant=args.plant_nan,
-            streamed=args.streamed,
+        from trlx_tpu.analysis.sanitizer import (
+            sanitize_engine_step,
+            sanitize_trainer,
         )
+
+        if args.engine_step:
+            result = sanitize_engine_step(
+                args.sanitize, mesh=mesh, plant=args.plant_nan
+            )
+        else:
+            result = sanitize_trainer(
+                args.sanitize, mesh=mesh, plant=args.plant_nan,
+                streamed=args.streamed,
+            )
         report = result.to_report()
         print(report.to_json() if args.json else result.format_text())
         return report.exit_code(strict=args.strict)

@@ -432,6 +432,17 @@ def drive_trainer(
             d_snap = register(
                 f"{kind}.behavior_snapshot", trainer._behavior_snapshot_jit
             )
+        engine = None
+        if kind == "ppo":
+            # the continuous-batching engine's programs (docs/inference.md)
+            # join the canonical loop: one mini slot-admission phase per
+            # pass — a retrace on the second pass means the engine's
+            # jitted shapes are not steady (e.g. per-phase state
+            # reallocation changed a shape)
+            engine = trainer.rollout_engine_obj
+            register(f"{kind}.engine_prefill", engine.prefill_jit)
+            register(f"{kind}.engine_decode_step", engine.decode_step_jit)
+            register(f"{kind}.engine_refill", engine.refill_jit)
 
         step_args: List[Any] = []  # captured (state, mb) signatures
 
@@ -461,6 +472,23 @@ def drive_trainer(
                 trainer.state, stacked
             )
             trainer._behavior_snapshot_jit(trainer.state.params)
+            if engine is not None:
+                # one harvest group through the slot-admission loop:
+                # fresh prompt VALUES per pass, stable shapes
+                import numpy as _np
+
+                rng = _np.random.default_rng(step_seed)
+                n = engine.harvest_width
+                eng_ids = rng.integers(1, 30, (n, Q)).astype(_np.int32)
+                engine.start_phase(
+                    trainer.rollout_params(),
+                    jax.random.fold_in(
+                        jax.random.PRNGKey(0), step_seed
+                    ),
+                )
+                engine.submit(eng_ids, _np.ones((n, Q), _np.int32))
+                for _group in engine.drive(n):
+                    pass
 
         one_pass(0)
         monitor.mark_steady()

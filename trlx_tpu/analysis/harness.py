@@ -510,7 +510,99 @@ def trace_trainer(
                 def_site=callable_def_site(trainer._behavior_snapshot_jit),
             )
         )
+    if kind == "ppo":
+        # the continuous-batching rollout engine's jitted programs
+        # (docs/inference.md) — traced once on the ppo trainer (every
+        # causal family shares the same engine code path)
+        programs.extend(_trace_engine_programs(trainer, kind, mesh_shape))
     return programs
+
+
+def _trace_engine_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram]:
+    """Trace the continuous-batching engine's prefill / decode_step /
+    refill (slot-recycle) programs (``trlx_tpu/inference/engine.py``).
+
+    The engine is built from the trainer's model/shardings regardless of
+    the configured ``train.rollout`` engine — the audit covers the
+    continuous path even while a run defaults to ``fixed``. Donation:
+    prefill/decode take (params, state) with the STATE donated, which the
+    donation rule (state-first contract) cannot express — only ``refill``
+    (state-first) carries the donation contract here.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.parallel.mesh import batch_sharding
+
+    axes = set(trainer.mesh.axis_names)
+    engine = trainer.rollout_engine_obj
+    state_sds = jax.eval_shape(engine._make_state)
+    params_sds = _sds(trainer.state.params)
+    A, C, Q = engine.admit_width, engine.harvest_width, engine.Q
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+    key_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    state_sh = engine.state_sharding()
+    batch_sh = batch_sharding(trainer.mesh)
+    params_sh = trainer.state_shardings.params
+    n_state = len(jax.tree_util.tree_leaves(state_sds))
+
+    prefill_args = (
+        params_sds, state_sds, i32(A), i32(A, Q), i32(A, Q), i32(A),
+        i32(A), key_sds,
+    )
+    prefill_prefixes = (
+        "params", "state", "slots", "prompt_ids", "prompt_mask",
+        "rows", "turns", "phase_key",
+    )
+    prefill_shardings = (
+        params_sh, state_sh, None, batch_sh, batch_sh, None, None, None,
+    )
+    decode_args = (params_sds, state_sds)
+    refill_args = (state_sds, i32(C))
+    return [
+        TracedProgram(
+            subject=f"{kind}.engine_prefill",
+            closed_jaxpr=jax.make_jaxpr(engine.prefill_jit)(*prefill_args),
+            mesh_axes=axes,
+            input_paths=flat_input_paths(
+                *prefill_args, prefixes=prefill_prefixes
+            ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                prefill_args, prefill_shardings
+            ),
+            def_site=callable_def_site(engine.prefill_jit),
+        ),
+        TracedProgram(
+            subject=f"{kind}.engine_decode_step",
+            closed_jaxpr=jax.make_jaxpr(engine.decode_step_jit)(
+                *decode_args
+            ),
+            mesh_axes=axes,
+            input_paths=flat_input_paths(
+                *decode_args, prefixes=("params", "state")
+            ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                decode_args, (params_sh, state_sh)
+            ),
+            def_site=callable_def_site(engine.decode_step_jit),
+        ),
+        TracedProgram(
+            subject=f"{kind}.engine_refill",
+            closed_jaxpr=jax.make_jaxpr(engine.refill_jit)(*refill_args),
+            mesh_axes=axes,
+            n_donated_state_leaves=n_state,
+            input_paths=flat_input_paths(
+                *refill_args, prefixes=("state", "slots")
+            ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                refill_args, (state_sh, None)
+            ),
+            def_site=callable_def_site(engine.refill_jit),
+        ),
+    ]
 
 
 def trace_all(

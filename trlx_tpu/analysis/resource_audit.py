@@ -473,9 +473,17 @@ def merge_budgets(
 ) -> Dict:
     """Fold a freshly-generated ``budgets`` dict into the ``existing``
     lockfile: the file-level and per-entry ``tolerance_pct`` overrides a
-    reviewer committed survive regeneration, and a *partial* update (a
+    reviewer committed survive regeneration, a *partial* update (a
     ``--trainers`` subset trace) keeps the untraced kinds' entries
-    instead of silently dropping them from the contract."""
+    instead of silently dropping them from the contract, and foreign
+    top-level sections owned by OTHER engines (``compile_budgets``,
+    engine 8; ``perf_budgets``, engine 10; anything future) pass through
+    untouched — a resource relock must never wipe another engine's
+    contract out of the shared lockfile."""
+    own_keys = {"schema_version", "mesh", "tolerance_pct", "programs"}
+    for key, val in existing.items():
+        if key not in own_keys:
+            budgets[key] = val
     if "tolerance_pct" in existing:
         budgets["tolerance_pct"] = existing["tolerance_pct"]
     old_programs = existing.get("programs", {})

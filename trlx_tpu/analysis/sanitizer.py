@@ -97,7 +97,13 @@ class _Replayer:
                 if math.isinf(val):
                     return "inf"
             return None
-        np_dtype = np.dtype(dtype)
+        try:
+            np_dtype = np.dtype(dtype)
+        except TypeError:
+            # extended dtypes (typed PRNG keys, `key<fry>`) have no
+            # numpy interpretation and no finiteness to check — the
+            # engine decode replay's per-row fold_in mints these
+            return None
         if np_dtype.kind != "f" and np_dtype.name not in (
             "bfloat16", "float16"  # ml_dtypes report numpy kind 'V'
         ):
@@ -359,6 +365,64 @@ def plant_nan(state):
     # .replace keeps every other field (ILQL's state carries
     # target_q_params beyond the common params/opt_state/step)
     return state.replace(params=params)
+
+
+def sanitize_engine_step(
+    kind: str,
+    mesh: Optional[Dict[str, int]] = None,
+    plant: bool = False,
+    seed: int = 0,
+) -> SanitizeResult:
+    """Replay the continuous-batching engine's ``decode_step``
+    (``trlx_tpu/inference/engine.py``) eqn-by-eqn on a concretely
+    prefilled slot pool.
+
+    The state is produced the way production produces it — a real
+    ``start_phase`` + admission prefill over random prompts — so a
+    NaN minted anywhere in the decode path (paged-cache gather, per-row
+    bias, token selection, value head) is localized to its first
+    offending equation exactly like ``--sanitize``'s train-step replay.
+    ``plant`` poisons one param leaf first (the CLI self-check).
+    """
+    import numpy as np
+
+    import jax
+
+    from trlx_tpu.analysis import harness
+    from trlx_tpu.analysis.harness import flat_input_paths
+
+    if kind != "ppo":
+        raise ValueError(
+            "--engine-step replays the causal continuous-batching "
+            f"engine via the ppo trainer; got {kind!r}"
+        )
+    trainer = harness.build_trainer(kind, mesh)
+    params = trainer.state.params
+    if plant:
+        params = plant_nan(trainer.state).params
+    engine = trainer.rollout_engine_obj
+    rng = np.random.default_rng(seed)
+    A, Q = engine.admit_width, engine.Q
+    vocab = getattr(trainer.model_config, "vocab_size", 32)
+    ids = rng.integers(1, max(2, vocab - 2), (A, Q)).astype(np.int32)
+    mask = np.ones((A, Q), np.int32)
+    engine.start_phase(params, jax.random.PRNGKey(seed))
+    engine.submit(ids, mask)
+    engine._admit()  # concrete prefill — the replay's input state
+    state = engine._state
+
+    closed = jax.make_jaxpr(engine.decode_step_jit)(params, state)
+    args = jax.tree_util.tree_leaves((params, state))
+    names = flat_input_paths(params, state, prefixes=("params", "state"))
+    mesh_shape = {k: int(v) for k, v in trainer.mesh.shape.items()}
+    return sanitize_jaxpr(
+        closed,
+        args,
+        subject=f"{kind}.engine_decode_step"
+        + (".planted" if plant else ""),
+        mesh=mesh_shape,
+        arg_names=names,
+    )
 
 
 def sanitize_trainer(

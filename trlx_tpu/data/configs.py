@@ -203,6 +203,21 @@ class TrainConfig:
     # scales / relative bias are consumed at f32).
     rollout_param_cast: bool = True
 
+    # Rollout engine selection (docs/inference.md): {"engine": "fixed" |
+    # "continuous", "slots": ..., "admit_width": ..., "harvest_width":
+    # ..., "block_size": ..., "per_row_rng": ...} — parsed into
+    # trlx_tpu.inference.RolloutEngineConfig. "continuous" replaces the
+    # fixed-batch segmented-scan sampler on the collect path with the
+    # slot-admission decode loop over a paged KV cache
+    # (trlx_tpu/inference/engine.py): prompts are admitted into vacated
+    # decode slots the step after a row emits eos, and completed
+    # rollouts stream into the buffer in fixed-width harvest groups.
+    # Per-row token-identical to the fixed sampler under per-row RNG
+    # (tests/test_inference_engine.py). Causal PPO-family trainers only
+    # (no pp mesh axis, no grouped/GRPO sampling yet); "fixed" is the
+    # default and the parity baseline.
+    rollout: Dict[str, Any] = field(default_factory=dict)
+
     # Streamed collect→train phase overlap (PPO-family trainers;
     # docs/async_pipeline.md): the behavior policy is snapshotted once per
     # phase, rollout chunks land incrementally in the streaming buffer, and

@@ -1,0 +1,109 @@
+"""Continuous-batching inference subsystem (docs/inference.md).
+
+The collect-phase decode loop re-built as a real inference engine
+(ROADMAP "make the rollout engine a real inference server"; PipelineRL's
+continuous rollout streams in PAPERS.md):
+
+- :mod:`trlx_tpu.inference.kv_cache` — paged/block KV cache: the same
+  ``[B, capacity]`` physical buffers the fixed sampler uses, plus
+  per-slot block tables indirecting logical positions through fixed-size
+  blocks, honoring ``kv_cache_dtype`` (int8) and the sp-sharded-cache
+  layout measured in LONGCTX.json;
+- :mod:`trlx_tpu.inference.engine` — the continuous-batching decode
+  loop: a fixed pool of decode slots, a host-side admission queue that
+  prefills a fresh prompt into a slot the step after its row emits eos,
+  per-row RNG keys (each row's tokens independent of admission order),
+  and completed rollouts harvested in fixed-width groups;
+- :mod:`trlx_tpu.inference.server` — the same engine as a standalone
+  batched-serving path (submit/poll against a loaded policy checkpoint,
+  no trainer required).
+
+Config surface: ``train.rollout`` (see :class:`RolloutEngineConfig`),
+e.g. ``rollout: {engine: continuous, slots: 128, block_size: 16}``. The
+fixed-batch sampler stays the default (``engine: fixed``) and the parity
+baseline: under per-row RNG the two engines produce per-row
+token-identical rollouts (tests/test_inference_engine.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+ROLLOUT_ENGINES = ("fixed", "continuous")
+
+
+@dataclass(frozen=True)
+class RolloutEngineConfig:
+    """Parsed ``train.rollout`` section.
+
+    :param engine: ``"fixed"`` (the segmented-scan sampler,
+        ``ops/sampling.py``) or ``"continuous"`` (the slot-admission
+        engine, :mod:`trlx_tpu.inference.engine`).
+    :param slots: decode-slot pool size B; 0 = the orchestrator's
+        ``chunk_size`` (so the engine's steady-state batch matches the
+        fixed sampler's).
+    :param admit_width: static width of one admission/prefill call
+        (padded with dummy rows); 0 = ``max(shard, slots // 4)`` where
+        ``shard`` is the mesh's data-shard count. Smaller = prompter
+        refills but more prefill dispatches.
+    :param harvest_width: completed rollouts per harvest group — the
+        downstream chunk size every scoring/ref/reward program compiles
+        at; 0 = ``admit_width``. Must divide into ``slots`` (<= slots).
+    :param block_size: paged-KV block size; auto-shrunk to the largest
+        divisor of the cache capacity (Q + max_new_tokens) so the
+        logical view stays exactly capacity-wide (bitwise parity with
+        the fixed cache needs no tail padding).
+    :param per_row_rng: force per-row RNG keys in the FIXED sampler too
+        (``None`` = only when ``engine == "continuous"``, which always
+        samples per-row). The parity tests run the fixed baseline with
+        ``per_row_rng: true``.
+    """
+
+    engine: str = "fixed"
+    slots: int = 0
+    admit_width: int = 0
+    harvest_width: int = 0
+    block_size: int = 16
+    per_row_rng: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.engine not in ROLLOUT_ENGINES:
+            raise ValueError(
+                f"train.rollout engine={self.engine!r} is not supported "
+                f"(choose one of {ROLLOUT_ENGINES})"
+            )
+        if self.block_size < 1:
+            raise ValueError(
+                f"train.rollout block_size={self.block_size} must be >= 1"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RolloutEngineConfig":
+        d = dict(d or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"Unknown train.rollout keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        for name in ("slots", "admit_width", "harvest_width", "block_size"):
+            if name in d and d[name] is not None:
+                d[name] = int(d[name])
+        return cls(**d)
+
+    @property
+    def rows_per_row_rng(self) -> bool:
+        """Whether the FIXED sampler should use per-row keys under this
+        config (the continuous engine always does)."""
+        if self.per_row_rng is not None:
+            return bool(self.per_row_rng)
+        return self.engine == "continuous"
+
+
+__all__ = [
+    "ROLLOUT_ENGINES",
+    "RolloutEngineConfig",
+]

@@ -1,0 +1,687 @@
+"""Continuous-batching decode engine: slot-admission rollout generation.
+
+The fixed-batch sampler (``ops/sampling.py``) decodes B prompts in
+lockstep: a row that emits eos at step 3 still occupies its batch lane
+for all ``max_new_tokens`` steps, emitting pad — at the bench shape that
+is the dominant collect-phase waste (BENCH_r05: collect MFU 0.157 vs
+0.299 train). This engine replaces the lockstep with a **fixed pool of B
+decode slots** and a host-side admission queue:
+
+- ``decode_step`` advances every slot one token (one compiled program,
+  static shapes — the pool IS the batch);
+- the step after a row emits eos (or exhausts its budget) the host sees
+  its ``done`` flag, harvests the finished rollout in a fixed-width
+  group, and **prefills a fresh prompt into the vacated slot** — decode
+  lanes never idle while prompts remain;
+- per-row RNG keys (``fold_in(phase_key, row_draw_index)`` then
+  ``fold_in(row_key, t)`` per step — ``ops/sampling.py::make_row_keys``/
+  ``choose_tokens``) make each row's tokens independent of admission
+  order and batch composition, so the engine is per-row token-identical
+  to the fixed sampler under ``per_row_rng`` (the parity contract,
+  tests/test_inference_engine.py);
+- the KV cache is the paged/block cache (``inference/kv_cache.py``):
+  slot recycling hands the new occupant a rotated block table, writes
+  and reads resolve through the table, and ``kv_cache_dtype: int8`` and
+  the sp-sharded capacity layout compose unchanged.
+
+Three jitted programs per engine (registered with the analysis harness
+as ``ppo.engine_prefill`` / ``ppo.engine_decode_step`` /
+``ppo.engine_refill``):
+
+- ``prefill(params, state, slots, prompts, mask, rows, turns, key)`` —
+  admission: forward the padded prompt batch, write its KV through the
+  (freshly rotated) block tables, seed per-slot sampling state;
+- ``decode_step(params, state)`` — one token for every slot; emissions
+  land in per-slot device output buffers; returns the [B] ``done``
+  flags the host polls;
+- ``refill(state, slots)`` — harvest: gather the finished slots'
+  rollouts and mark the slots free (the admission queue refills them on
+  the next poll).
+
+Host loop cost model: one small [B]-bool device->host fetch per decode
+step (the admission decision needs last step's flags — "the step after
+eos"). The fetch is started asynchronously right behind the dispatch;
+on hardware the admission lag can be widened to k steps by polling
+every k-th step (slots then idle at most k-1 extra steps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu import telemetry
+from trlx_tpu.inference.kv_cache import choose_block_size
+from trlx_tpu.ops.sampling import (
+    GenerationConfig,
+    choose_tokens,
+    concat_cols,
+    make_row_keys,
+)
+
+
+@struct.dataclass
+class EngineState:
+    """Device-resident state of the slot pool; every leaf's leading axis
+    is the slot axis (sharded over dp×fsdp like any batch)."""
+
+    cache: Any  # paged KV cache (tuple of per-layer dicts)
+    row_keys: jax.Array  # [B, 2] uint32 per-row base keys
+    t: jax.Array  # [B] int32 tokens emitted by the current occupant
+    n_real: jax.Array  # [B] int32 real prompt length
+    logits_last: jax.Array  # [B, V] float32 logits at the next decision
+    value_last: jax.Array  # [B] float32 value estimate at that decision
+    active: jax.Array  # [B] bool — slot holds an unharvested row
+    finished: jax.Array  # [B] bool — row hit eos / length cap
+    out_tokens: jax.Array  # [B, R] int32 (pad after eos)
+    out_mask: jax.Array  # [B, R] int32
+    out_logprobs: jax.Array  # [B, R] float32
+    out_values: jax.Array  # [B, R] float32
+    query_ids: jax.Array  # [B, Q] int32 (left-padded prompt)
+    query_mask: jax.Array  # [B, Q] int32
+    row_index: jax.Array  # [B] int32 global draw index of the occupant
+
+
+@dataclasses.dataclass
+class EngineStats:
+    """Host-side occupancy/throughput counters for one phase."""
+
+    admitted: int = 0
+    completed: int = 0
+    prefills: int = 0
+    decode_steps: int = 0
+    recycles: int = 0
+    occupancy_sum: int = 0  # sum over steps of active slots
+    num_slots: int = 0
+
+    @property
+    def slot_util(self) -> float:
+        denom = self.num_slots * self.decode_steps
+        return self.occupancy_sum / denom if denom else 0.0
+
+    def to_dict(self) -> Dict[str, float]:
+        return {
+            "engine/admitted": float(self.admitted),
+            "engine/completed": float(self.completed),
+            "engine/prefills": float(self.prefills),
+            "engine/decode_steps": float(self.decode_steps),
+            "engine/slot_recycles": float(self.recycles),
+            "engine/slot_util": round(self.slot_util, 4),
+        }
+
+
+class ContinuousBatchingEngine:
+    """Slot-admission decode over a paged KV cache.
+
+    :param apply_fn: the model forward —
+        ``apply_fn(params, input_ids, attention_mask, position_ids,
+        cache, cache_index[, last_only]) -> {"logits", "cache"
+        [, "values"]}`` (the same contract ``make_sampler`` consumes).
+    :param init_cache_fn: ``(batch, capacity) -> linear KV buffers``
+        (the family's ``init_cache``; the engine adds block tables).
+    :param gen_config: generation parameters; the engine always samples
+        per-row (``per_row_rng`` is forced on).
+    :param num_slots: decode-slot pool size B.
+    :param admit_width: static admission batch width (padded with dummy
+        rows; one compiled prefill shape).
+    :param harvest_width: completed rollouts per harvest group — the
+        chunk size downstream consumers compile at. Must be <= num_slots.
+    :param block_size: requested paged-KV block size (shrunk to divide
+        Q + max_new_tokens).
+    :param mesh / param_shardings / cache_sharding: optional GSPMD
+        pinning; ``cache_sharding`` shards the capacity axis (sp).
+    """
+
+    def __init__(
+        self,
+        *,
+        apply_fn: Callable,
+        init_cache_fn: Callable,
+        gen_config: GenerationConfig,
+        query_length: int,
+        vocab_size: int,
+        num_slots: int,
+        admit_width: int = 0,
+        harvest_width: int = 0,
+        block_size: int = 16,
+        mesh=None,
+        param_shardings=None,
+        cache_sharding=None,
+        with_values: bool = True,
+    ):
+        self.gen_config = dataclasses.replace(gen_config, per_row_rng=True)
+        self.Q = int(query_length)
+        self.R = int(self.gen_config.max_new_tokens)
+        self.capacity = self.Q + self.R
+        self.vocab_size = int(vocab_size)
+        self.num_slots = int(num_slots)
+        self.block_size = choose_block_size(self.capacity, block_size)
+        self.n_blocks = self.capacity // self.block_size
+        self.with_values = with_values
+        self._apply_fn = apply_fn
+        self._init_cache_fn = init_cache_fn
+        self.mesh = mesh
+        shard = 1
+        if mesh is not None:
+            shape = dict(mesh.shape)
+            shard = shape.get("dp", 1) * shape.get("fsdp", 1)
+        self._shard = shard
+
+        def round_up(n: int) -> int:
+            return max(shard, ((n + shard - 1) // shard) * shard)
+
+        self.admit_width = round_up(
+            admit_width or max(1, self.num_slots // 4)
+        )
+        self.admit_width = min(self.admit_width, round_up(self.num_slots))
+        self.harvest_width = round_up(harvest_width or self.admit_width)
+        if self.harvest_width > self.num_slots:
+            raise ValueError(
+                f"harvest_width={self.harvest_width} cannot exceed "
+                f"num_slots={self.num_slots} (a harvest group must fit "
+                "in the pool or the drain deadlocks)"
+            )
+        if self.num_slots % shard:
+            raise ValueError(
+                f"num_slots={self.num_slots} must divide over the "
+                f"{shard} data shards of the mesh"
+            )
+
+        self._prefill_kwargs = (
+            {"last_only": True}
+            if "last_only" in inspect.signature(apply_fn).parameters
+            else {}
+        )
+        self._param_shardings = param_shardings
+        self._cache_sharding = cache_sharding
+        self._build_programs()
+
+        # host bookkeeping (reset per phase)
+        self._state: Optional[EngineState] = None
+        self._params = None
+        self._phase_key = None
+        self._queue: List[Tuple[np.ndarray, np.ndarray, int]] = []
+        self._free: List[int] = []
+        self._busy_rows: Dict[int, int] = {}  # slot -> row index
+        self._done_slots: List[int] = []
+        self._recycle_counts = np.zeros(self.num_slots, np.int64)
+        self._next_row = 0
+        self.stats = EngineStats(num_slots=self.num_slots)
+
+    # ------------------------- jitted programs ------------------------- #
+
+    def init_state(self) -> EngineState:
+        """Fresh all-idle pool, committed to the engine's shardings.
+        Idle slots are ``active=False, finished=True``:
+        ``choose_tokens`` then emits deterministic (pad, 0, 0.0, 0.0)
+        for them and their output/cache writes hit the out-of-bounds
+        discard sentinel."""
+        state = self._make_state()
+        if self.mesh is not None:
+            state = jax.device_put(state, self.state_sharding())
+        return state
+
+    def _make_state(self) -> EngineState:
+        from trlx_tpu.inference.kv_cache import identity_block_tables
+
+        B, Q, R, V = self.num_slots, self.Q, self.R, self.vocab_size
+        cfg = self.gen_config
+        linear = self._init_cache_fn(B, self.capacity)
+        tables = identity_block_tables(B, self.n_blocks)
+        # one table array PER layer (logically shared, physically
+        # distinct): the jitted programs donate the whole state, and XLA
+        # refuses to donate one buffer appearing as several arguments
+        cache = tuple(
+            dict(layer, block_tables=jnp.array(tables)) for layer in linear
+        )
+        return EngineState(
+            cache=cache,
+            row_keys=jnp.zeros((B, 2), jnp.uint32),
+            t=jnp.zeros((B,), jnp.int32),
+            n_real=jnp.zeros((B,), jnp.int32),
+            logits_last=jnp.zeros((B, V), jnp.float32),
+            value_last=jnp.zeros((B,), jnp.float32),
+            active=jnp.zeros((B,), bool),
+            finished=jnp.ones((B,), bool),
+            out_tokens=jnp.full((B, R), cfg.pad_token_id, jnp.int32),
+            out_mask=jnp.zeros((B, R), jnp.int32),
+            out_logprobs=jnp.zeros((B, R), jnp.float32),
+            out_values=jnp.zeros((B, R), jnp.float32),
+            query_ids=jnp.zeros((B, Q), jnp.int32),
+            query_mask=jnp.zeros((B, Q), jnp.int32),
+            row_index=jnp.full((B,), -1, jnp.int32),
+        )
+
+    def state_sharding(self):
+        """Sharding pytree for :class:`EngineState`: slot axis over
+        dp×fsdp everywhere; cache K/V capacity axis additionally over sp
+        when a ``cache_sharding`` was given (the LONGCTX layout)."""
+        from trlx_tpu.parallel.mesh import batch_sharding
+
+        batch_sh = batch_sharding(self.mesh)
+        cache_sh = self._cache_sharding or batch_sh
+
+        def layer_sharding(layer: Dict[str, Any]) -> Dict[str, Any]:
+            return {
+                k: (cache_sh if v.ndim == 4 else batch_sh)
+                for k, v in layer.items()
+            }
+
+        def pick(state: EngineState):
+            cache = tuple(layer_sharding(l) for l in state.cache)
+            other = {
+                f.name: batch_sh
+                for f in dataclasses.fields(EngineState)
+                if f.name != "cache"
+            }
+            return EngineState(cache=cache, **other)
+
+        # build from an abstract state so no buffers materialize here
+        return pick(jax.eval_shape(self._make_state))
+
+    def _build_programs(self) -> None:
+        cfg = self.gen_config
+        Q, R, cap, B = self.Q, self.R, self.capacity, self.num_slots
+        nb, bs = self.n_blocks, self.block_size
+        apply_fn = self._apply_fn
+        with_values = self.with_values
+        prefill_kwargs = self._prefill_kwargs
+
+        def pin_cache(cache):
+            if self._cache_sharding is None:
+                return cache
+            sh = self._cache_sharding
+            return tuple(
+                {
+                    k: (
+                        jax.lax.with_sharding_constraint(v, sh)
+                        if v.ndim == 4
+                        else v
+                    )
+                    for k, v in layer.items()
+                }
+                for layer in cache
+            )
+
+        def prefill(
+            params,
+            state: EngineState,
+            slot_ids,  # [A] int32; num_slots = dummy (writes drop)
+            prompt_ids,  # [A, Q] int32 left-padded
+            prompt_mask,  # [A, Q] int32
+            row_index,  # [A] int32 global draw index
+            table_turns,  # [A] int32 block-table rotation per slot
+            phase_key,  # [2] uint32
+        ) -> EngineState:
+            A = prompt_ids.shape[0]
+            row_keys = make_row_keys(phase_key, row_index)
+            n_real = jnp.sum(prompt_mask, axis=-1).astype(jnp.int32)
+
+            # recycled slots get a rotated block table: physical block
+            # reuse order differs from logical order, so table
+            # resolution is exercised on every refill
+            new_tables = (
+                (jnp.arange(nb, dtype=jnp.int32)[None, :] + table_turns[:, None])
+                % nb
+            )
+
+            def slice_layer(layer):
+                sl = {
+                    k: jnp.take(v, slot_ids, axis=0)
+                    for k, v in layer.items()
+                    if k != "block_tables"
+                }
+                sl["block_tables"] = new_tables
+                return sl
+
+            cache_slice = tuple(slice_layer(l) for l in state.cache)
+            cache_mask = concat_cols(
+                prompt_mask, jnp.zeros((A, R), prompt_mask.dtype)
+            )
+            positions = jnp.clip(jnp.cumsum(prompt_mask, axis=-1) - 1, 0, None)
+            out = apply_fn(
+                params,
+                prompt_ids,
+                attention_mask=cache_mask,
+                position_ids=positions,
+                cache=cache_slice,
+                cache_index=0,
+                **prefill_kwargs,
+            )
+            logits_last = out["logits"][:, -1].astype(jnp.float32)
+            if with_values:
+                value_last = out["values"][:, -1].astype(jnp.float32)
+            else:
+                value_last = jnp.zeros((A,), jnp.float32)
+            if cfg.max_length > 0:
+                finished0 = n_real >= cfg.max_length
+            else:
+                finished0 = jnp.zeros((A,), bool)
+
+            def merge_layer(full, sl):
+                return {
+                    k: full[k]
+                    .at[slot_ids]
+                    .set(sl[k].astype(full[k].dtype), mode="drop")
+                    for k in full
+                }
+
+            new_cache = tuple(
+                merge_layer(f, s) for f, s in zip(state.cache, out["cache"])
+            )
+
+            def put(field, rows):
+                return field.at[slot_ids].set(
+                    rows.astype(field.dtype), mode="drop"
+                )
+
+            return dataclasses.replace(
+                state,
+                cache=pin_cache(new_cache),
+                row_keys=put(state.row_keys, row_keys),
+                t=put(state.t, jnp.zeros((A,), jnp.int32)),
+                n_real=put(state.n_real, n_real),
+                logits_last=put(state.logits_last, logits_last),
+                value_last=put(state.value_last, value_last),
+                active=put(state.active, jnp.ones((A,), bool)),
+                finished=put(state.finished, finished0),
+                out_tokens=put(
+                    state.out_tokens,
+                    jnp.full((A, R), cfg.pad_token_id, jnp.int32),
+                ),
+                out_mask=put(state.out_mask, jnp.zeros((A, R), jnp.int32)),
+                out_logprobs=put(
+                    state.out_logprobs, jnp.zeros((A, R), jnp.float32)
+                ),
+                out_values=put(
+                    state.out_values, jnp.zeros((A, R), jnp.float32)
+                ),
+                query_ids=put(state.query_ids, prompt_ids),
+                query_mask=put(state.query_mask, prompt_mask),
+                row_index=put(state.row_index, row_index),
+            )
+
+        def decode_step(params, state: EngineState):
+            """One token for every slot. Finished/idle slots ride along
+            with deterministic pad emissions whose output and cache
+            writes resolve out of bounds and drop."""
+            if cfg.min_new_tokens > 0 or cfg.min_length > 0:
+                min_new = jnp.maximum(
+                    cfg.min_new_tokens, cfg.min_length - state.n_real
+                )
+            else:
+                min_new = None
+            token, live, logprob, value_out, finished = choose_tokens(
+                cfg,
+                state.logits_last,
+                state.t,
+                state.finished,
+                state.value_last,
+                state.n_real,
+                min_new=min_new,
+                row_keys=state.row_keys,
+            )
+            rows = jnp.arange(B, dtype=jnp.int32)
+            # emissions land at [slot, t] for live rows; non-live rows
+            # write at R (out of bounds -> dropped)
+            w = jnp.where(live == 1, state.t, R)
+            out_tokens = state.out_tokens.at[rows, w].set(token, mode="drop")
+            out_mask = state.out_mask.at[rows, w].set(live, mode="drop")
+            out_logprobs = state.out_logprobs.at[rows, w].set(
+                logprob, mode="drop"
+            )
+            out_values = state.out_values.at[rows, w].set(
+                value_out, mode="drop"
+            )
+
+            # forward the sampled token at per-row cache slot Q + t;
+            # non-live rows write at capacity (dropped by the paged
+            # cache's OOB sentinel)
+            slot_pos = jnp.arange(cap)[None, :]
+            cache_mask_t = (
+                slot_pos <= Q + state.t[:, None]
+            ).astype(jnp.int32) * concat_cols(
+                state.query_mask, jnp.ones((B, R), state.query_mask.dtype)
+            )
+            cache_index = jnp.where(live == 1, Q + state.t, cap)
+            out = apply_fn(
+                params,
+                token[:, None],
+                attention_mask=cache_mask_t,
+                position_ids=(state.n_real + state.t)[:, None],
+                cache=state.cache,
+                cache_index=cache_index,
+            )
+            new_logits = out["logits"][:, 0].astype(jnp.float32)
+            new_value = (
+                out["values"][:, 0].astype(jnp.float32)
+                if with_values
+                else jnp.zeros((B,), jnp.float32)
+            )
+            t_next = jnp.where(live == 1, state.t + 1, state.t)
+            done = state.active & (finished | (t_next >= R))
+            new_state = dataclasses.replace(
+                state,
+                cache=pin_cache(out["cache"]),
+                t=t_next,
+                logits_last=new_logits,
+                value_last=new_value,
+                finished=finished,
+                out_tokens=out_tokens,
+                out_mask=out_mask,
+                out_logprobs=out_logprobs,
+                out_values=out_values,
+            )
+            return new_state, done
+
+        def refill(state: EngineState, slot_ids):
+            """Harvest ``slot_ids``'s finished rollouts and free the
+            slots (the admission queue prefills them next poll)."""
+            outs = {
+                "query_tokens": jnp.take(state.query_ids, slot_ids, axis=0),
+                "query_mask": jnp.take(state.query_mask, slot_ids, axis=0),
+                "tokens": jnp.take(state.out_tokens, slot_ids, axis=0),
+                "response_mask": jnp.take(state.out_mask, slot_ids, axis=0),
+                "logprobs": jnp.take(state.out_logprobs, slot_ids, axis=0),
+                "values": jnp.take(state.out_values, slot_ids, axis=0),
+                "row_index": jnp.take(state.row_index, slot_ids, axis=0),
+            }
+            active = state.active.at[slot_ids].set(False, mode="drop")
+            return dataclasses.replace(state, active=active), outs
+
+        if self.mesh is not None and self._param_shardings is not None:
+            from trlx_tpu.parallel.mesh import batch_sharding, replicated
+
+            state_sh = self.state_sharding()
+            batch_sh = batch_sharding(self.mesh)
+            rep = replicated(self.mesh)
+            self.prefill_jit = jax.jit(
+                prefill,
+                in_shardings=(
+                    self._param_shardings,
+                    state_sh,
+                    rep,
+                    batch_sh,
+                    batch_sh,
+                    rep,
+                    rep,
+                    rep,
+                ),
+                out_shardings=state_sh,
+                donate_argnums=(1,),
+            )
+            self.decode_step_jit = jax.jit(
+                decode_step,
+                in_shardings=(self._param_shardings, state_sh),
+                out_shardings=(state_sh, rep),
+                donate_argnums=(1,),
+            )
+            self.refill_jit = jax.jit(
+                refill,
+                in_shardings=(state_sh, rep),
+                out_shardings=(state_sh, batch_sh),
+                donate_argnums=(0,),
+            )
+        else:
+            self.prefill_jit = jax.jit(prefill, donate_argnums=(1,))
+            self.decode_step_jit = jax.jit(decode_step, donate_argnums=(1,))
+            self.refill_jit = jax.jit(refill, donate_argnums=(0,))
+
+    # --------------------------- host loop ----------------------------- #
+
+    def start_phase(self, params, phase_key, row_start: int = 0) -> None:
+        """Reset the pool for a new collect phase. ``params`` is the
+        frozen behavior policy every prefill/decode of the phase runs on
+        (under the streamed phase: the trainer's behavior snapshot);
+        ``phase_key`` seeds the per-row keys; ``row_start`` offsets the
+        global draw index (usually 0 per phase)."""
+        self._params = params
+        self._phase_key = jnp.asarray(phase_key, jnp.uint32)
+        self._state = self.init_state()
+        self._queue = []
+        self._free = list(range(self.num_slots))
+        self._busy_rows = {}
+        self._done_slots = []
+        self._recycle_counts[:] = 0
+        self._next_row = row_start
+        self.stats = EngineStats(num_slots=self.num_slots)
+
+    def submit(self, prompt_ids, prompt_mask) -> List[int]:
+        """Enqueue prompts (host arrays, [n, Q]); returns their global
+        row indices (draw order — the per-row RNG identity)."""
+        ids = np.asarray(prompt_ids)
+        mask = np.asarray(prompt_mask)
+        if ids.ndim != 2 or ids.shape[1] != self.Q:
+            raise ValueError(
+                f"submit expects [n, Q={self.Q}] prompt ids, got {ids.shape}"
+            )
+        rows = []
+        for i in range(ids.shape[0]):
+            row = self._next_row
+            self._next_row += 1
+            self._queue.append((ids[i], mask[i], row))
+            rows.append(row)
+        return rows
+
+    @property
+    def pending(self) -> int:
+        """Rows submitted but not yet harvested."""
+        return len(self._queue) + len(self._busy_rows) + len(self._done_slots)
+
+    def _admit(self) -> None:
+        """Refill free slots from the queue, one padded prefill call per
+        ``admit_width`` group."""
+        while self._free and self._queue:
+            with telemetry.span("collect/admit", force=True):
+                A = self.admit_width
+                take = min(len(self._free), len(self._queue), A)
+                slots = [self._free.pop(0) for _ in range(take)]
+                entries = [self._queue.pop(0) for _ in range(take)]
+                prompt_ids = np.zeros((A, self.Q), np.int32)
+                prompt_mask = np.zeros((A, self.Q), np.int32)
+                slot_ids = np.full((A,), self.num_slots, np.int32)  # dummies
+                row_index = np.zeros((A,), np.int32)
+                turns = np.zeros((A,), np.int32)
+                for i, (slot, (ids, mask, row)) in enumerate(
+                    zip(slots, entries)
+                ):
+                    prompt_ids[i] = ids
+                    prompt_mask[i] = mask
+                    slot_ids[i] = slot
+                    row_index[i] = row
+                    turns[i] = self._recycle_counts[slot]
+                    self._busy_rows[slot] = row
+                args = (prompt_ids, prompt_mask)
+                if self.mesh is not None:
+                    from trlx_tpu.parallel.mesh import batch_sharding
+
+                    args = jax.device_put(args, batch_sharding(self.mesh))
+            with telemetry.span(
+                "collect/prefill", force=True, admitted=take
+            ):
+                self._state = self.prefill_jit(
+                    self._params,
+                    self._state,
+                    jnp.asarray(slot_ids),
+                    args[0],
+                    args[1],
+                    jnp.asarray(row_index),
+                    jnp.asarray(turns),
+                    self._phase_key,
+                )
+            self.stats.prefills += 1
+            self.stats.admitted += take
+
+    def _harvest_ready(self) -> Iterator[Dict[str, Any]]:
+        """Yield fixed-width harvest groups while enough slots are done."""
+        C = self.harvest_width
+        while len(self._done_slots) >= C:
+            slots = self._done_slots[:C]
+            self._done_slots = self._done_slots[C:]
+            with telemetry.span(
+                "collect/slot_recycle", force=True, harvested=C
+            ):
+                self._state, outs = self.refill_jit(
+                    self._state, jnp.asarray(slots, jnp.int32)
+                )
+            rows = [self._busy_rows.pop(s) for s in slots]
+            for s in slots:
+                self._recycle_counts[s] += 1
+                self._free.append(s)
+            self.stats.recycles += C
+            self.stats.completed += C
+            outs = dict(outs)
+            outs["rows"] = rows  # host-side draw indices, harvest order
+            yield outs
+
+    def drive(self, target: int) -> Iterator[Dict[str, Any]]:
+        """Run the admission/decode/harvest loop until ``target``
+        completed rollouts have been yielded (in ``harvest_width``
+        groups). ``target`` must be a multiple of ``harvest_width`` and
+        must not exceed the submitted row count."""
+        C = self.harvest_width
+        if target % C:
+            raise ValueError(
+                f"target={target} must be a multiple of "
+                f"harvest_width={C} (fixed-shape harvest groups)"
+            )
+        if target > self.pending + self.stats.completed:
+            raise ValueError(
+                f"drive(target={target}) but only {self.pending} rows "
+                "are pending — submit the phase's prompts first"
+            )
+        yielded = 0
+        while yielded < target:
+            for group in self._harvest_ready():
+                yield group
+                yielded += len(group["rows"])
+                if yielded >= target:
+                    return
+            self._admit()
+            if not self._busy_rows:
+                # nothing decoding and nothing harvestable: the queue
+                # must be empty too (else _admit would have filled)
+                raise RuntimeError(
+                    "engine starved: no active slots and no full "
+                    f"harvest group ({len(self._done_slots)} done < "
+                    f"{C}) — target/harvest_width mismatch"
+                )
+            self._state, done = self.decode_step_jit(
+                self._params, self._state
+            )
+            try:
+                done.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+            self.stats.decode_steps += 1
+            self.stats.occupancy_sum += len(self._busy_rows)
+            done_host = np.asarray(jax.device_get(done))
+            for slot, row in list(self._busy_rows.items()):
+                if done_host[slot] and slot not in self._done_slots:
+                    self._done_slots.append(slot)

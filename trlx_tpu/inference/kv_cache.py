@@ -1,0 +1,213 @@
+"""Paged/block KV cache for the continuous-batching engine.
+
+vLLM-style paging adapted to the TPU/GSPMD substrate: physical storage
+keeps the fixed sampler's ``[B, capacity, heads, head_dim]`` per-layer
+buffers (so the batch axis shards over dp×fsdp exactly like the fixed
+cache, and an ``sp`` mesh axis shards the capacity axis per the
+LONGCTX.json sp-sharded-cache row), while a per-slot **block table**
+indirects logical token positions through fixed-size blocks:
+
+- physical layout: capacity = ``n_blocks * block_size`` contiguous
+  positions per slot; block ``j`` of slot ``b`` is positions
+  ``[j*bs, (j+1)*bs)`` of ``pool[b]``;
+- ``block_tables[b, j]`` maps *logical* block ``j`` to a *physical*
+  block index inside slot ``b``'s region. Writes and reads both resolve
+  through the table, so a recycled slot can be handed a permuted table
+  (the engine rotates tables on recycle — the indirection is exercised,
+  not decorative);
+- reads materialize the slot's **logical view** — a per-position gather
+  back into logical order — so attention over the paged cache is the
+  exact computation the fixed cache runs (bitwise: a gather permutes,
+  it never re-associates any reduction). This is what makes
+  ``rollout.engine: continuous`` per-row token-identical to the fixed
+  sampler.
+
+``kv_cache_dtype`` is honored exactly as in the linear cache
+(``models/gpt2.py::kv_buffers``): ``int8`` stores quantized values +
+per-(position, head) bf16 scales and dequantizes on read — the same
+absmax/127 quantizer, so int8 paged and int8 linear caches hold
+identical bits per logical position.
+
+Why per-slot block regions instead of one global pool: a single shared
+pool would put every slot's blocks behind one un-sharded physical axis,
+breaking the dp×fsdp batch sharding that keeps decode local to each data
+shard. Per-slot regions keep GSPMD layouts identical to the fixed cache;
+the paging machinery (tables, block-granular recycling) is unchanged,
+only the allocator's arena is per-slot.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def choose_block_size(capacity: int, requested: int) -> int:
+    """Largest divisor of ``capacity`` that is <= ``requested``.
+
+    The logical view must be exactly ``capacity`` wide: a non-dividing
+    block size would pad the view with tail positions whose masked-out
+    (but present) slots change the softmax reduction shape — breaking
+    bitwise parity with the fixed cache.
+    """
+    if capacity < 1:
+        raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+    bs = max(1, min(int(requested), capacity))
+    while capacity % bs:
+        bs -= 1
+    return bs
+
+
+def identity_block_tables(n_slots: int, n_blocks: int) -> jax.Array:
+    """[B, n_blocks] int32 identity mapping (fresh slots)."""
+    return jnp.broadcast_to(
+        jnp.arange(n_blocks, dtype=jnp.int32)[None, :], (n_slots, n_blocks)
+    )
+
+
+def rotate_block_table(table, turns: int):
+    """Rotate one slot's table by ``turns`` blocks (host or device array).
+
+    The engine hands a recycled slot a rotated table so physical block
+    reuse order differs from logical order — block-table indirection is
+    exercised on every recycle, and a table-resolution bug shows up as a
+    parity break instead of lying dormant behind identity tables.
+    """
+    n = table.shape[-1]
+    k = int(turns) % n
+    if k == 0:
+        return table
+    return jnp.concatenate([table[..., k:], table[..., :k]], axis=-1)
+
+
+def init_paged_cache(
+    n_layer: int,
+    n_slots: int,
+    capacity: int,
+    n_head: int,
+    head_dim: int,
+    dtype,
+    kv_cache_dtype: str = "bfloat16",
+    block_size: int = 16,
+) -> Tuple[Dict[str, jax.Array], ...]:
+    """Per-layer paged KV buffers + shared block tables.
+
+    Layer dicts carry the physical pools under the linear cache's key
+    names ("k"/"v" [+ scales]) plus "block_tables" — the presence of
+    that key is what routes ``models/gpt2.py::write_cache`` onto the
+    paged write/read path, so every causal family decodes through the
+    paged cache with no model changes.
+    """
+    from trlx_tpu.models.gpt2 import kv_buffers
+
+    bs = choose_block_size(capacity, block_size)
+    n_blocks = capacity // bs
+    tables = identity_block_tables(n_slots, n_blocks)
+    layers = kv_buffers(
+        n_layer, n_slots, capacity, n_head, head_dim, dtype, kv_cache_dtype
+    )
+    # per-layer table copies: donated-state programs must not see one
+    # buffer behind several arguments (XLA double-donation refusal)
+    return tuple(
+        dict(layer, block_tables=jnp.array(tables)) for layer in layers
+    )
+
+
+def physical_positions(
+    block_tables: jax.Array,  # [B, n_blocks] int32
+    positions: jax.Array,  # [B, T] logical positions (may be >= capacity)
+    capacity: int,
+) -> jax.Array:
+    """[B, T] physical positions; out-of-range logical positions map to
+    ``capacity`` (out of bounds), which scatters DROP — the engine uses
+    position >= capacity as the "discard this write" sentinel for
+    finished/inactive slots."""
+    n_blocks = block_tables.shape[-1]
+    bs = capacity // n_blocks
+    pos = jnp.asarray(positions, jnp.int32)
+    blk = jnp.clip(pos // bs, 0, n_blocks - 1)
+    phys_blk = jnp.take_along_axis(block_tables, blk, axis=1)
+    phys = phys_blk * bs + pos % bs
+    # preserve OOB-ness: the table gather above CLIPS, so a position past
+    # capacity would otherwise alias the last block and corrupt it
+    return jnp.where((pos >= 0) & (pos < capacity), phys, capacity)
+
+
+def logical_view_index(block_tables: jax.Array, capacity: int) -> jax.Array:
+    """[B, capacity] gather index: physical position of each logical
+    position (the read-side permutation)."""
+    n_blocks = block_tables.shape[-1]
+    bs = capacity // n_blocks
+    offs = jnp.arange(bs, dtype=jnp.int32)[None, None, :]
+    phys = block_tables[:, :, None] * bs + offs  # [B, n_blocks, bs]
+    return phys.reshape(block_tables.shape[0], capacity)
+
+
+def _gather_logical(pool: jax.Array, view_idx: jax.Array) -> jax.Array:
+    """Gather ``pool`` [B, cap, ...] rows into logical order."""
+    b_idx = jnp.arange(pool.shape[0], dtype=jnp.int32)[:, None]
+    return pool[b_idx, view_idx]
+
+
+def _scatter_rows(pool: jax.Array, phys: jax.Array, rows: jax.Array) -> jax.Array:
+    """Scatter ``rows`` [B, T, ...] into ``pool`` [B, cap, ...] at
+    physical positions ``phys`` [B, T]; OOB positions drop (jax scatter
+    semantics — the discard sentinel relies on this)."""
+    b_idx = jnp.arange(pool.shape[0], dtype=jnp.int32)[:, None]
+    return pool.at[b_idx, phys].set(rows.astype(pool.dtype), mode="drop")
+
+
+def paged_write_read(
+    cache_kv: Dict[str, jax.Array],
+    k: jax.Array,  # [B, T, H, Dh] new keys (compute dtype)
+    v: jax.Array,
+    cache_index,  # scalar or [B] logical base position of the new rows
+    dtype,
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Paged counterpart of the linear ``write_cache`` arm: write the new
+    K/V rows through the block table, then return the **logical view** of
+    the whole buffer for attention (plus the updated cache dict).
+
+    ``cache_index`` may be per-slot (the continuous engine's rows sit at
+    different depths) or scalar (broadcast). int8 pools quantize on write
+    and dequantize the gathered view — same bits as the linear int8 path
+    per logical position.
+    """
+    B, T = k.shape[0], k.shape[1]
+    capacity = cache_kv["k"].shape[1]
+    tables = cache_kv["block_tables"]
+    base = jnp.broadcast_to(jnp.asarray(cache_index, jnp.int32), (B,))
+    positions = base[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    phys = physical_positions(tables, positions, capacity)
+    view = logical_view_index(tables, capacity)
+
+    if "k_scale" in cache_kv:
+        from trlx_tpu.models.gpt2 import quantize_kv
+
+        k_q, k_s = quantize_kv(k)
+        v_q, v_s = quantize_kv(v)
+        new_kv = {
+            "k": _scatter_rows(cache_kv["k"], phys, k_q),
+            "v": _scatter_rows(cache_kv["v"], phys, v_q),
+            "k_scale": _scatter_rows(cache_kv["k_scale"], phys, k_s),
+            "v_scale": _scatter_rows(cache_kv["v_scale"], phys, v_s),
+            "block_tables": tables,
+        }
+        k_full = _gather_logical(new_kv["k"], view).astype(dtype) * (
+            _gather_logical(new_kv["k_scale"], view).astype(dtype)
+        )
+        v_full = _gather_logical(new_kv["v"], view).astype(dtype) * (
+            _gather_logical(new_kv["v_scale"], view).astype(dtype)
+        )
+        return k_full, v_full, new_kv
+
+    new_kv = {
+        "k": _scatter_rows(cache_kv["k"], phys, k),
+        "v": _scatter_rows(cache_kv["v"], phys, v),
+        "block_tables": tables,
+    }
+    k_full = _gather_logical(new_kv["k"], view)
+    v_full = _gather_logical(new_kv["v"], view)
+    return k_full, v_full, new_kv

@@ -1,0 +1,336 @@
+"""Standalone batched serving over the continuous-batching engine.
+
+The "millions of users" half of the ROADMAP item: the same
+slot-admission engine the collect phase drives
+(:mod:`trlx_tpu.inference.engine`) exposed as a trainer-less serving
+API — load a policy (from-scratch config, HF conversion, or a trainer
+checkpoint directory), ``submit`` prompt batches, ``poll`` completed
+generations. No optimizer, no buffer, no orchestrator: the model
+forward, the paged KV cache, and the admission loop are the whole
+dependency surface.
+
+Quickstart (docs/inference.md):
+
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.inference.server import InferenceServer
+
+    server = InferenceServer(TRLConfig.load_yaml("configs/ppo_gpt2.yml"),
+                             checkpoint_dir="ckpts")
+    ids = server.submit([[464, 3290, 318], [1212, 318]])
+    results = server.wait(ids)          # {id: {"tokens": ..., "text": ...}}
+
+Request lifecycle: ``submit`` left-pads and enqueues (host), the engine
+admits into vacated decode slots, ``flush``/``wait`` drive the loop;
+results are retained until ``pop_result``/``wait`` hands them out. A
+:class:`~trlx_tpu.telemetry.health.HealthMonitor` watches per-group
+generation stats (``health/`` series — non-finite logprobs/values trip
+``nan-precursor``), so a served checkpoint that decodes garbage
+surfaces as health events, not silent junk; the CI ``serving-smoke``
+job asserts a clean run stays at zero events.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from trlx_tpu.data.configs import TRLConfig
+
+
+class InferenceServer:
+    """Submit/poll batched generation against a loaded policy.
+
+    :param config: :class:`TRLConfig` (or its dict form) — ``model``
+        selects the architecture/checkpoint conversion, ``train.mesh``
+        the device mesh, ``method.gen_kwargs`` the generation
+        parameters, ``train.rollout`` the engine geometry (slots /
+        admit_width / harvest_width / block_size; the ``engine`` field
+        is ignored — serving is always continuous).
+    :param checkpoint_dir: optional trainer checkpoint directory
+        (``utils/checkpoint``): the policy params are restored from the
+        saved train state (optimizer state is read but discarded).
+    :param params: optional explicit policy param pytree (overrides
+        ``checkpoint_dir``).
+    :param tokenizer: optional tokenizer for string prompts / decoded
+        results (falls back to ``model.tokenizer_path``).
+    """
+
+    def __init__(
+        self,
+        config: Union[TRLConfig, Dict[str, Any]],
+        checkpoint_dir: Optional[str] = None,
+        params=None,
+        tokenizer=None,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from trlx_tpu.inference import RolloutEngineConfig
+        from trlx_tpu.inference.engine import ContinuousBatchingEngine
+        from trlx_tpu.models.heads import CausalLMWithValueHead
+        from trlx_tpu.ops.sampling import (
+            GenerationConfig,
+            validate_gen_config,
+        )
+        from trlx_tpu.parallel import make_mesh, make_partition_specs
+        from trlx_tpu.telemetry.health import HealthConfig, HealthMonitor
+        from trlx_tpu.trainer.ppo_trainer import get_causal_arch
+
+        if not isinstance(config, TRLConfig):
+            config = TRLConfig.from_dict(config)
+        self.config = config
+        train = config.train
+        self.mesh = make_mesh(train.mesh)
+        if dict(self.mesh.shape).get("pp", 1) > 1:
+            raise NotImplementedError(
+                "InferenceServer serves under plain GSPMD; drop the pp "
+                "mesh axis (pipeline decode is a trainer-path feature)"
+            )
+
+        self.family, self.model_config, init_params = get_causal_arch(config)
+        self.model = CausalLMWithValueHead(
+            self.model_config, backbone_cls=self.family.backbone_cls
+        )
+
+        self.tokenizer = tokenizer
+        if tokenizer is None and config.model.tokenizer_path:
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(
+                config.model.tokenizer_path, local_files_only=True
+            )
+
+        gen_kwargs = dict(config.method.gen_kwargs)
+        self.gen_config = GenerationConfig.from_dict(gen_kwargs)
+        validate_gen_config(
+            self.gen_config,
+            getattr(self.model_config, "vocab_size", None),
+            provided=set(gen_kwargs),
+        )
+        self.query_length = train.seq_length
+
+        # --- params: explicit > checkpoint > converted > from-scratch ---
+        rng = jax.random.PRNGKey(seed)
+        rng, init_rng = jax.random.split(rng)
+        if params is None:
+            params = self.model.init(
+                init_rng, jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+            if init_params is not None:
+                params["transformer"] = init_params  # converted backbone
+            if checkpoint_dir is not None:
+                from trlx_tpu.utils.checkpoint import load_checkpoint
+
+                # restore the checkpoint as saved (no abstract spec —
+                # serving must not need the training run's optimizer
+                # layout) and keep only the policy params
+                state, _meta = load_checkpoint(checkpoint_dir, None)
+                saved = state["params"] if isinstance(state, dict) else (
+                    state.params
+                )
+                flat_live = jax.tree_util.tree_structure(params)
+                flat_saved = jax.tree_util.tree_structure(saved)
+                if flat_live != flat_saved:
+                    raise ValueError(
+                        f"checkpoint under {checkpoint_dir} holds a "
+                        "different param structure than model config "
+                        f"{type(self.model_config).__name__} builds — "
+                        "check model.model_arch/model_type against the "
+                        "training run"
+                    )
+                params = saved
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        specs = make_partition_specs(
+            params, self.mesh, self.family.partition_rules
+        )
+        self.param_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self.params = jax.device_put(params, self.param_shardings)
+
+        rollout = RolloutEngineConfig.from_dict(train.rollout)
+        num_slots = rollout.slots or int(
+            getattr(config.method, "chunk_size", 0) or train.batch_size
+        )
+
+        def apply_fn(p, input_ids, attention_mask=None, position_ids=None,
+                     cache=None, cache_index=None, last_only=False):
+            return self.model.apply(
+                {"params": p},
+                input_ids,
+                attention_mask=attention_mask,
+                position_ids=position_ids,
+                cache=cache,
+                cache_index=cache_index,
+                last_only=last_only,
+            )
+
+        import functools
+
+        self.engine = ContinuousBatchingEngine(
+            apply_fn=apply_fn,
+            init_cache_fn=functools.partial(
+                self.family.init_cache, self.model_config
+            ),
+            gen_config=self.gen_config,
+            query_length=self.query_length,
+            vocab_size=self.model_config.vocab_size,
+            num_slots=num_slots,
+            admit_width=rollout.admit_width,
+            harvest_width=rollout.harvest_width,
+            block_size=rollout.block_size,
+            mesh=self.mesh,
+            param_shardings=self.param_shardings,
+            with_values=True,
+        )
+        # fold_in consumes rng without a dangling split chain (the
+        # key-lineage engine's key-discard rule)
+        phase_key = jax.random.fold_in(rng, 7)
+        self.engine.start_phase(self.params, phase_key)
+
+        # generation-health watch: non-finite logprobs/values in a served
+        # group trip nan-precursor; zero events == healthy checkpoint
+        self.health_monitor = HealthMonitor(
+            HealthConfig.from_dict({"enabled": True})
+        )
+        self._results: Dict[int, Dict[str, Any]] = {}
+        self._open: Dict[int, bool] = {}
+        self._groups_served = 0
+
+    # ------------------------------ API -------------------------------- #
+
+    @property
+    def health_events(self) -> List[Any]:
+        return list(self.health_monitor.events)
+
+    def _encode(self, prompt) -> List[int]:
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompts require a tokenizer")
+            return list(self.tokenizer.encode(prompt))
+        return list(prompt)
+
+    def submit(self, prompts: Sequence[Any]) -> List[int]:
+        """Enqueue prompts (strings with a tokenizer, or token-id lists /
+        arrays); returns request ids. Prompts longer than
+        ``train.seq_length`` are refused (truncation would silently serve
+        a different prompt)."""
+        Q = self.query_length
+        pad_id = self.gen_config.pad_token_id
+        n = len(prompts)
+        ids = np.full((n, Q), pad_id, np.int32)
+        mask = np.zeros((n, Q), np.int32)
+        for i, p in enumerate(prompts):
+            toks = self._encode(p)
+            if not toks:
+                raise ValueError(f"prompt {i} is empty")
+            if len(toks) > Q:
+                raise ValueError(
+                    f"prompt {i} has {len(toks)} tokens > seq_length={Q}"
+                )
+            ids[i, Q - len(toks):] = toks  # left-pad, as the trainer does
+            mask[i, Q - len(toks):] = 1
+        rows = self.engine.submit(ids, mask)
+        for r in rows:
+            self._open[r] = True
+        self._last_prompt = (ids[-1].copy(), mask[-1].copy())
+        return rows
+
+    def _observe_group(self, group) -> None:
+        lp = np.asarray(group["logprobs"])
+        vals = np.asarray(group["values"])
+        m = np.asarray(group["response_mask"]).astype(bool)
+        picked = lp[m] if m.any() else lp.ravel()
+        row = {
+            "health/logprob_mean": float(picked.mean()),
+            "health/logprob_min": float(picked.min()),
+            "health/value_mean": float(vals[m].mean() if m.any() else 0.0),
+        }
+        self.health_monitor.observe(row, step=self._groups_served)
+        self._groups_served += 1
+
+    def flush(self) -> int:
+        """Drive the engine until every submitted request has completed;
+        returns the number of newly completed requests. The queue is
+        padded to a whole number of harvest groups with duplicate rows
+        (discarded on harvest) so shapes stay fixed."""
+        import jax
+
+        engine = self.engine
+        pending_rows = [r for r, open_ in self._open.items() if open_]
+        if not pending_rows:
+            return 0
+        Hw = engine.harvest_width
+        n = engine.pending
+        target = ((n + Hw - 1) // Hw) * Hw
+        if target > n:
+            # pad the queue to a whole number of fixed-shape harvest
+            # groups with copies of the last real prompt; their results
+            # are discarded on harvest
+            fill_ids, fill_mask = self._last_prompt
+            pad_rows = engine.submit(
+                np.repeat(fill_ids[None, :], target - n, axis=0),
+                np.repeat(fill_mask[None, :], target - n, axis=0),
+            )
+        else:
+            pad_rows = []
+        pad_set = set(pad_rows)
+        completed = 0
+        for group in engine.drive(target):
+            toks = np.asarray(jax.device_get(group["tokens"]))
+            mask = np.asarray(jax.device_get(group["response_mask"]))
+            self._observe_group(group)
+            for j, r in enumerate(group["rows"]):
+                if r in pad_set or r not in self._open:
+                    continue
+                length = int(mask[j].sum())
+                out: Dict[str, Any] = {
+                    "tokens": toks[j, :length].tolist(),
+                    "length": length,
+                }
+                if self.tokenizer is not None:
+                    out["text"] = self.tokenizer.decode(
+                        out["tokens"], skip_special_tokens=True
+                    )
+                self._results[r] = out
+                self._open[r] = False
+                completed += 1
+        return completed
+
+    def poll(self, request_id: int) -> Optional[Dict[str, Any]]:
+        """Completed result for ``request_id`` (None while in flight);
+        the result stays claimable until :meth:`pop_result`."""
+        return self._results.get(request_id)
+
+    def pop_result(self, request_id: int) -> Optional[Dict[str, Any]]:
+        self._open.pop(request_id, None)
+        return self._results.pop(request_id, None)
+
+    def wait(self, request_ids: Sequence[int]) -> Dict[int, Dict[str, Any]]:
+        """Drive until every id in ``request_ids`` has a result; returns
+        and pops them."""
+        missing = [r for r in request_ids if r not in self._results]
+        if missing:
+            self.flush()
+        still = [r for r in request_ids if r not in self._results]
+        if still:
+            raise RuntimeError(
+                f"requests {still} did not complete — were they submitted?"
+            )
+        return {r: self.pop_result(r) for r in request_ids}
+
+    def generate(self, prompts: Sequence[Any]) -> List[Dict[str, Any]]:
+        """Blocking convenience: submit + wait, results in prompt order."""
+        rids = self.submit(prompts)
+        done = self.wait(rids)
+        return [done[r] for r in rids]
+
+    def stats(self) -> Dict[str, float]:
+        """Engine occupancy/throughput counters (cumulative this phase)."""
+        return self.engine.stats.to_dict()

@@ -268,15 +268,24 @@ def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 def write_cache(cache_kv, k, v, cache_index, dtype):
     """Write this step's K/V into the capacity buffers at ``cache_index``;
     returns ``(k, v, new_kv)`` — the full buffers to attend over and the
-    updated cache dict. Transparent over the two storage layouts (shared
-    by every causal family):
+    updated cache dict. Transparent over the three storage layouts
+    (shared by every causal family):
 
     - plain: ``{"k", "v"}`` in the compute dtype;
     - int8 (``kv_cache_dtype="int8"``): quantize the new slice, store
       value+scale, dequantize the whole buffer for attention — the
       convert+mul folds into the attention matmuls' operand read, so HBM
-      sees int8, the MXU sees bf16.
+      sees int8, the MXU sees bf16;
+    - paged (``"block_tables"`` present — the continuous-batching
+      engine's cache, ``inference/kv_cache.py``): writes resolve logical
+      positions through per-slot block tables (``cache_index`` may be a
+      per-slot [B] vector), reads return the logical view; composes
+      with the int8 layout.
     """
+    if "block_tables" in cache_kv:
+        from trlx_tpu.inference.kv_cache import paged_write_read
+
+        return paged_write_read(cache_kv, k, v, cache_index, dtype)
     at = (0, cache_index, 0, 0)
     if "k_scale" in cache_kv:
         k_q, k_s = quantize_kv(k)

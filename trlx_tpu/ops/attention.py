@@ -33,10 +33,20 @@ def causal_bias(q_len: int, kv_len: int, offset: int = 0, dtype=jnp.float32) -> 
 
     ``offset`` is the absolute position of the first query token — used when
     decoding with a KV cache where queries sit at positions
-    ``offset..offset+Q-1`` of a ``kv_len``-capacity buffer.
+    ``offset..offset+Q-1`` of a ``kv_len``-capacity buffer. A [B]-vector
+    ``offset`` (rows decoding at different depths — the continuous-batching
+    engine) yields a [B, 1, Q, K] bias instead.
     """
-    q_pos = jnp.arange(q_len)[:, None] + offset
+    off = jnp.asarray(offset)
     k_pos = jnp.arange(kv_len)[None, :]
+    if off.ndim:
+        q_pos = (
+            jnp.arange(q_len)[None, :, None]
+            + off.astype(jnp.int32)[:, None, None]
+        )  # [B, Q, 1]
+        mask = k_pos[None, :, :] <= q_pos
+        return jnp.where(mask, 0.0, NEG_INF).astype(dtype)[:, None, :, :]
+    q_pos = jnp.arange(q_len)[:, None] + off
     mask = k_pos <= q_pos
     return jnp.where(mask, 0.0, NEG_INF).astype(dtype)[None, None, :, :]
 

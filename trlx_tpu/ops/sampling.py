@@ -74,6 +74,16 @@ class GenerationConfig:
     # disables segmentation (one monolithic scan). Segmented and monolithic
     # decode are bitwise-identical (tests/test_sampling.py).
     decode_segment_size: int = 8
+    # Per-row RNG (docs/inference.md): the sampler's ``rng`` argument is a
+    # [B, 2] array of per-row base keys instead of one batch key, and step
+    # t of row b samples with ``fold_in(row_keys[b], t)`` — each row's
+    # token sequence depends only on (its key, its logits), never on batch
+    # composition or position. This is the contract that makes the
+    # continuous-batching engine (which always samples per-row) per-row
+    # token-identical to this fixed-batch sampler regardless of admission
+    # order. Default off: the legacy one-key-per-step batch draw stays
+    # bitwise-stable for existing runs.
+    per_row_rng: bool = False
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "GenerationConfig":
@@ -157,6 +167,102 @@ def suppress_eos_before_min(
     return jnp.where(active[:, None] & eos_col[None, :], -jnp.inf, logits)
 
 
+def concat_cols(a: jax.Array, b: jax.Array) -> jax.Array:
+    """[B, Qa] ++ [B, Qb] along axis 1 via dynamic_update_slice.
+
+    NOT jnp.concatenate: the masks this builds feed shard_map programs
+    (pp decode) and committed-sharded buffers, and XLA's SPMD partitioner
+    mis-lowers a concatenate operand on any mesh with a spare size>1
+    axis — the same compiler-bug family as the sharded rollout-concat
+    replica-sum (data/ppo_types.py::concat_rollouts) and the stage
+    stacking (tools/pp_miscompile_repro.py). Shared by the fixed-batch
+    sampler and the continuous engine's mask construction."""
+    buf = jnp.zeros((a.shape[0], a.shape[1] + b.shape[1]), a.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, a, (0, 0))
+    return jax.lax.dynamic_update_slice(buf, b.astype(a.dtype), (0, a.shape[1]))
+
+
+def make_row_keys(phase_key: jax.Array, indices: jax.Array) -> jax.Array:
+    """[N, 2] per-row base keys: ``fold_in(phase_key, index)`` per row.
+
+    ``indices`` are the rows' global draw positions within the phase —
+    the same prompt drawn at the same position gets the same key whether
+    it decodes in the fixed batch or through the continuous engine's
+    slots, which is the root of the two engines' per-row parity."""
+    return jax.vmap(lambda i: jax.random.fold_in(phase_key, i))(
+        jnp.asarray(indices, jnp.int32)
+    )
+
+
+def choose_tokens(
+    gen_config: GenerationConfig,
+    logits_last: jax.Array,  # [B, V] float32 raw logits
+    t,  # scalar or [B] per-row decode step
+    finished: jax.Array,  # [B] bool
+    value_last: jax.Array,  # [B] float32
+    n_real,  # [B] real prompt lengths (for the max_length cap)
+    min_new=None,  # scalar/[B] eos-suppression horizon (None = off)
+    key=None,  # batch mode: one key for the whole [B, V] draw
+    row_keys=None,  # per-row mode: [B, 2] base keys, folded with t
+):
+    """One decode step's token selection — the kernel shared by the
+    fixed-batch sampler and the continuous engine's ``decode_step``.
+
+    Returns ``(token, live_i32, logprob, value_out, finished_next)`` with
+    the fixed sampler's exact semantics: finished rows emit deterministic
+    ``(pad, 0, 0.0, 0.0)``; the behavior logprob is taken under the RAW
+    logits; ``finished_next`` folds in eos and the HF total-length cap.
+    Exactly one of ``key`` / ``row_keys`` must be given when sampling.
+    """
+    if gen_config.forced_bos_token_id >= 0:
+        forced = jnp.full(
+            (logits_last.shape[0],), gen_config.forced_bos_token_id, jnp.int32
+        )
+    else:
+        forced = None
+    choice_logits = suppress_eos_before_min(logits_last, t, gen_config, min_new)
+    if gen_config.do_sample:
+        filtered = filter_logits(choice_logits, gen_config)
+        if row_keys is not None:
+            B = logits_last.shape[0]
+            keys_t = jax.vmap(jax.random.fold_in)(
+                row_keys, jnp.broadcast_to(jnp.asarray(t, jnp.int32), (B,))
+            )
+            token = jax.vmap(
+                lambda kk, lg: jax.random.categorical(kk, lg)
+            )(keys_t, filtered)
+        else:
+            token = jax.random.categorical(key, filtered, axis=-1)
+    else:
+        token = jnp.argmax(choice_logits, axis=-1)
+    token = token.astype(jnp.int32)
+    if forced is not None:
+        token = jnp.where(jnp.asarray(t) == 0, forced, token)
+    token = jnp.where(finished, gen_config.pad_token_id, token)
+
+    # behavior logprob under the *raw* logits: gather + logsumexp
+    # (one [B] gather instead of materializing [B, V] log_softmax)
+    logprob = (
+        jnp.take_along_axis(logits_last, token[:, None], axis=-1)[:, 0]
+        - jax.scipy.special.logsumexp(logits_last, axis=-1)
+    )
+    live = jnp.logical_not(finished)
+    # finished rows emit deterministic zeros for logprob/value (these
+    # slots are response_mask==0 everywhere downstream): the emissions
+    # then depend only on `finished`, never on the post-finish
+    # logits/values — which is what lets the segmented decode (and the
+    # engine's recycled slots) skip/ignore stale state bitwise-safely.
+    logprob = jnp.where(live, logprob, 0.0)
+    value_out = jnp.where(live, value_last, 0.0)
+    finished = jnp.logical_or(finished, token == gen_config.eos_token_id)
+    if gen_config.max_length > 0:
+        # HF total-length cap: prompt + generated >= max_length
+        finished = jnp.logical_or(
+            finished, n_real + jnp.asarray(t) + 1 >= gen_config.max_length
+        )
+    return token, live.astype(jnp.int32), logprob, value_out, finished
+
+
 def filter_logits(logits: jax.Array, cfg: GenerationConfig) -> jax.Array:
     """Temperature / top-k / top-p filtering (float32 in, float32 out)."""
     if cfg.temperature != 1.0:
@@ -204,19 +310,6 @@ def make_sampler(
     Q = query_length
     R = gen_config.max_new_tokens
     cap = Q + R
-
-    def concat_cols(a, b):
-        """[B, Qa] ++ [B, Qb] along axis 1 via dynamic_update_slice.
-
-        NOT jnp.concatenate: the mask this builds feeds the pp decode's
-        shard_map, and XLA's SPMD partitioner mis-lowers a concatenate
-        operand of a shard_map on any mesh with a spare size>1 axis —
-        the same compiler-bug family as the sharded rollout-concat
-        replica-sum (data/ppo_types.py::concat_rollouts) and the stage
-        stacking (tools/pp_miscompile_repro.py)."""
-        buf = jnp.zeros((a.shape[0], a.shape[1] + b.shape[1]), a.dtype)
-        buf = jax.lax.dynamic_update_slice(buf, a, (0, 0))
-        return jax.lax.dynamic_update_slice(buf, b.astype(a.dtype), (0, a.shape[1]))
 
     def pin_cache(cache):
         if cache_sharding is None:
@@ -273,46 +366,22 @@ def make_sampler(
 
         def step(carry, t):
             cache, logits_last, value_last, finished, rng = carry
-            rng, key = jax.random.split(rng)
-
-            if gen_config.forced_bos_token_id >= 0:
-                forced = jnp.full((B,), gen_config.forced_bos_token_id, jnp.int32)
+            if gen_config.per_row_rng:
+                # `rng` is the [B, 2] per-row base keys — folded with t
+                # inside choose_tokens, never chained through the carry
+                key, row_keys = None, rng
             else:
-                forced = None
-            choice_logits = suppress_eos_before_min(logits_last, t, gen_config, min_new)
-            if gen_config.do_sample:
-                filtered = filter_logits(choice_logits, gen_config)
-                token = jax.random.categorical(key, filtered, axis=-1)
-            else:
-                token = jnp.argmax(choice_logits, axis=-1)
-            token = token.astype(jnp.int32)
-            if forced is not None:
-                token = jnp.where(t == 0, forced, token)
-            token = jnp.where(finished, gen_config.pad_token_id, token)
-
-            # behavior logprob under the *raw* logits: gather + logsumexp
-            # (one [B] gather instead of materializing [B, V] log_softmax)
-            logprob = (
-                jnp.take_along_axis(logits_last, token[:, None], axis=-1)[:, 0]
-                - jax.scipy.special.logsumexp(logits_last, axis=-1)
+                rng, key = jax.random.split(rng)
+                row_keys = None
+            # token selection + behavior logprob: the kernel shared with
+            # the continuous engine's decode_step (finished rows emit
+            # deterministic (pad, 0, 0.0, 0.0) — see choose_tokens)
+            token, live, logprob, value_out, finished = choose_tokens(
+                gen_config, logits_last, t, finished, value_last, n_real,
+                min_new=min_new, key=key, row_keys=row_keys,
             )
-            live = jnp.logical_not(finished)
-            # finished rows emit deterministic zeros for logprob/value
-            # (these slots are response_mask==0 everywhere downstream):
-            # the emissions then depend only on `finished`, never on the
-            # post-finish logits/values — which is what lets the segmented
-            # decode skip the transformer apply for an all-finished
-            # segment and stay bitwise-identical to the monolithic scan.
-            logprob = jnp.where(live, logprob, 0.0)
-            value_out = jnp.where(live, value_last, 0.0)
-            finished = jnp.logical_or(finished, token == gen_config.eos_token_id)
-            if gen_config.max_length > 0:
-                # HF total-length cap: prompt + generated >= max_length
-                finished = jnp.logical_or(
-                    finished, n_real + t + 1 >= gen_config.max_length
-                )
 
-            ys = (token, live.astype(jnp.int32), logprob, value_out)
+            ys = (token, live, logprob, value_out)
 
             # forward the sampled token at slot Q+t
             cache_mask_t = (slot_ids <= Q + t).astype(jnp.int32) * concat_cols(
@@ -367,10 +436,16 @@ def make_sampler(
             def skip_seg(carry, ts):
                 cache, logits_last, value_last, finished, rng = carry
 
-                def skip_step(r, t):
-                    return jax.random.split(r)[0], None
+                if not gen_config.per_row_rng:
+                    # legacy batch keys chain through the carry: advance
+                    # by exactly one split per skipped step so segmented
+                    # and monolithic decode stay bitwise-identical.
+                    # Per-row keys are fold_in(row_key, t) — stateless in
+                    # t — so there is nothing to advance.
+                    def skip_step(r, t):
+                        return jax.random.split(r)[0], None
 
-                rng, _ = jax.lax.scan(skip_step, rng, ts)
+                    rng, _ = jax.lax.scan(skip_step, rng, ts)
                 k = ts.shape[0]
                 ys = (
                     jnp.full((k, B), gen_config.pad_token_id, jnp.int32),

@@ -132,6 +132,33 @@ class PPOOrchestrator(Orchestrator):
             samples=samples, queries=queries, response_gt=response_gt
         )
 
+    def _scale_scores(self, scores: np.ndarray, method) -> np.ndarray:
+        """Reward scaling + clip (`ppo_orchestrator.py:96-112`), shared by
+        the fixed-batch and continuous collect paths. The reference seeds
+        ref stats from the first rollout batch when unset (`:97-98`) and
+        always advances the running moments."""
+        if self.ref_mean is None:
+            self.ref_mean, self.ref_std = (
+                float(scores.mean()), float(scores.std())
+            )
+        self.running.update(scores)
+        if method.scale_reward == "running":
+            if self.running.std > 0:
+                scores = scores / self.running.std
+        elif method.scale_reward == "ref" and self.ref_std:
+            scores = scores / self.ref_std
+        elif method.scale_reward == "group":
+            # whiten within each same-prompt group (beyond parity;
+            # rows are group-contiguous via _expand_groups)
+            from trlx_tpu.ops.ppo_math import group_whiten
+
+            scores = group_whiten(scores, self.group_size)
+        if method.cliprange_reward:
+            scores = np.clip(
+                scores, -method.cliprange_reward, method.cliprange_reward,
+            )
+        return scores
+
     def _log_rollouts(self, queries, texts, scores, iter_count: int) -> None:
         """Enqueue collected rollouts for ``train.rollout_logging_dir`` as
         JSON lines (query/response/raw score), rank-0 only — the writes
@@ -192,6 +219,176 @@ class PPOOrchestrator(Orchestrator):
         return batch, meta, sample_out, ref_logprobs, dispatch_ms
 
     def make_experience(self, num_rollouts: int = 128, iter_count: int = 0):
+        """Collect one phase of experience — dispatched on the trainer's
+        configured rollout engine (``train.rollout``): the fixed-batch
+        double-buffered chunk loop (the default and parity baseline), or
+        the continuous-batching slot-admission engine
+        (docs/inference.md)."""
+        if getattr(self.trainer, "rollout_engine", "fixed") == "continuous":
+            return self._make_experience_continuous(num_rollouts, iter_count)
+        return self._make_experience_fixed(num_rollouts, iter_count)
+
+    def _finish_collect_stats(
+        self,
+        clock,
+        collected: int,
+        all_scores,
+        generate_time: float,
+        dispatch_time: float,
+        score_time: float,
+        iter_count: int,
+        extra=None,
+    ):
+        """Shared collect epilogue: assemble the stats row, feed the
+        run-health detectors, and log — identical keys on both engines so
+        bench/dashboards diff across the config switch."""
+        exp_time = clock.tick() / 1000.0
+        scores_cat = np.concatenate(all_scores)
+        stats = {
+            "exp/generate_time": generate_time,
+            "exp/dispatch_time": dispatch_time,
+            "exp/score_time": score_time,
+            "exp/experience_time": exp_time,
+            "exp/score_mean": float(scores_cat.mean()),
+            "exp/score_std": float(scores_cat.std()),
+            "exp/running_mean": float(self.running.mean),
+            "exp/running_std": float(self.running.std),
+            "exp/rollouts_per_sec": collected / max(exp_time, 1e-9),
+            "policy/mean_rollout_kl": self.trainer.mean_kl,
+        }
+        if extra:
+            stats.update(extra)
+        # run-health: the collect stats row feeds the detectors too —
+        # exp/score_std is the reward-saturation series. Host floats
+        # only; the device-resident mean_rollout_kl scalar is skipped by
+        # the monitor (never forced) and observed later from the phase's
+        # fetched update rows.
+        observe = getattr(self.trainer, "observe_health", None)
+        if observe is not None:
+            observe(
+                stats,
+                step=iter_count,
+                phase=getattr(self.trainer, "health_phase_id", None),
+            )
+        if getattr(self.trainer, "logger", None) is not None:
+            self.trainer.logger.log(stats, step=iter_count)
+        return stats
+
+    def _make_experience_continuous(
+        self, num_rollouts: int, iter_count: int
+    ):
+        """Drive the continuous-batching engine for one phase: submit the
+        phase's prompt draw into the admission queue, then score/land
+        each fixed-width harvest group as it completes — rollouts stream
+        into the buffer in finish order, and the streamed-phase hook
+        dispatches epoch-1 updates exactly as on the fixed path."""
+        method: PPOConfig = self.trainer.config.method
+        clock = Clock()
+        collected = 0
+        generate_time = 0.0
+        dispatch_time = 0.0
+        score_time = 0.0
+        all_scores = []
+        engine = self.trainer.rollout_engine_obj
+        Hw = engine.harvest_width
+        # fixed-shape harvest groups: round the target up exactly like
+        # the fixed path's full-size chunks overshoot num_rollouts
+        target = ((int(num_rollouts) + Hw - 1) // Hw) * Hw
+        streamed_hook = getattr(self.trainer, "on_rollouts_landed", None)
+        meta_by_row = {}
+        have_gt = self.pipeline.response_gt is not None
+
+        with telemetry.span(
+            "phase/collect", force=True, rollouts=int(num_rollouts)
+        ):
+            try:
+                with telemetry.span("collect/dispatch", force=True) as sp:
+                    engine.start_phase(
+                        self.trainer.rollout_params(),
+                        self.trainer.rollout_phase_key(),
+                    )
+                    # draw the phase's prompts into the admission queue
+                    # (row index = draw order = the per-row RNG identity)
+                    while engine.pending + engine.stats.completed < target:
+                        with telemetry.span("collect/prompt_draw"):
+                            batch, meta = next(self._loader)
+                        batch, meta = self._expand_groups(batch, meta)
+                        rows = engine.submit(
+                            np.asarray(batch.input_ids),
+                            np.asarray(batch.attention_mask),
+                        )
+                        for i, r in enumerate(rows):
+                            meta_by_row[r] = (
+                                meta["prompts_text"][i],
+                                meta["response_gt"][i] if have_gt else None,
+                            )
+                dispatch_time += sp.duration_ms / 1000.0
+
+                for group in engine.drive(target):
+                    # frozen-ref forward queued right behind the harvest;
+                    # it runs on device while Python scores the group
+                    ref_logprobs = self.trainer.score_ref(
+                        group["query_tokens"],
+                        group["query_mask"],
+                        group["tokens"],
+                        group["response_mask"],
+                    )
+                    with telemetry.span("collect/decode", force=True) as sp:
+                        texts = self.trainer.decode_responses(
+                            group["tokens"], group["response_mask"]
+                        )
+                    generate_time += sp.duration_ms / 1000.0
+                    rows = group["rows"]
+                    queries = [meta_by_row[r][0] for r in rows]
+                    gts = (
+                        [meta_by_row[r][1] for r in rows] if have_gt else None
+                    )
+                    with telemetry.span("collect/score", force=True) as sp:
+                        scores = np.asarray(
+                            self.score(texts, queries, gts), dtype=np.float32
+                        )
+                    score_time += sp.duration_ms / 1000.0
+                    all_scores.append(scores.copy())
+                    self._log_rollouts(queries, texts, scores, iter_count)
+                    scores = self._scale_scores(scores, method)
+
+                    with telemetry.span("collect/land") as land_sp:
+                        rewards = self.trainer.compute_rewards(
+                            group["logprobs"],
+                            ref_logprobs,
+                            group["response_mask"],
+                            scores,
+                        )
+                        self.trainer.buffer.push(
+                            PPORolloutBatch(
+                                query_tokens=group["query_tokens"],
+                                query_mask=group["query_mask"],
+                                response_tokens=group["tokens"],
+                                response_mask=group["response_mask"],
+                                logprobs=group["logprobs"],
+                                values=group["values"],
+                                rewards=rewards,
+                            )
+                        )
+                        collected += len(rows)
+                        land_sp.set(landed=collected)
+                        if streamed_hook is not None:
+                            streamed_hook()
+            except BaseException:
+                if self._rollout_writer is not None:
+                    self._rollout_writer.flush(reraise=False)
+                raise
+            if self._rollout_writer is not None:
+                self._rollout_writer.flush(reraise=True)
+
+        return self._finish_collect_stats(
+            clock, collected, all_scores, generate_time, dispatch_time,
+            score_time, iter_count, extra=engine.stats.to_dict(),
+        )
+
+    def _make_experience_fixed(
+        self, num_rollouts: int = 128, iter_count: int = 0
+    ):
         method: PPOConfig = self.trainer.config.method
         clock = Clock()
         stats = {}
@@ -252,31 +449,7 @@ class PPOOrchestrator(Orchestrator):
                     all_scores.append(scores.copy())
                     self._log_rollouts(queries, texts, scores, iter_count)
 
-                    # reward scaling + clip (`ppo_orchestrator.py:96-112`). The
-                    # reference seeds ref stats from the first rollout batch
-                    # when unset (`:97-98`) and always advances the running
-                    # moments.
-                    if self.ref_mean is None:
-                        self.ref_mean, self.ref_std = (
-                            float(scores.mean()), float(scores.std())
-                        )
-                    self.running.update(scores)
-                    if method.scale_reward == "running":
-                        if self.running.std > 0:
-                            scores = scores / self.running.std
-                    elif method.scale_reward == "ref" and self.ref_std:
-                        scores = scores / self.ref_std
-                    elif method.scale_reward == "group":
-                        # whiten within each same-prompt group (beyond parity;
-                        # rows are group-contiguous via _expand_groups)
-                        from trlx_tpu.ops.ppo_math import group_whiten
-
-                        scores = group_whiten(scores, self.group_size)
-                    if method.cliprange_reward:
-                        scores = np.clip(
-                            scores, -method.cliprange_reward,
-                            method.cliprange_reward,
-                        )
+                    scores = self._scale_scores(scores, method)
 
                     with telemetry.span("collect/land") as land_sp:
                         rewards = self.trainer.compute_rewards(
@@ -321,34 +494,8 @@ class PPOOrchestrator(Orchestrator):
             if self._rollout_writer is not None:
                 self._rollout_writer.flush(reraise=True)
 
-        exp_time = clock.tick() / 1000.0
-        scores_cat = np.concatenate(all_scores)
-        stats.update(
-            {
-                "exp/generate_time": generate_time,
-                "exp/dispatch_time": dispatch_time,
-                "exp/score_time": score_time,
-                "exp/experience_time": exp_time,
-                "exp/score_mean": float(scores_cat.mean()),
-                "exp/score_std": float(scores_cat.std()),
-                "exp/running_mean": float(self.running.mean),
-                "exp/running_std": float(self.running.std),
-                "exp/rollouts_per_sec": collected / max(exp_time, 1e-9),
-                "policy/mean_rollout_kl": self.trainer.mean_kl,
-            }
-        )
-        # run-health: the collect stats row feeds the detectors too —
-        # exp/score_std is the reward-saturation series. Host floats
-        # only; the device-resident mean_rollout_kl scalar is skipped by
-        # the monitor (never forced) and observed later from the phase's
-        # fetched update rows.
-        observe = getattr(self.trainer, "observe_health", None)
-        if observe is not None:
-            observe(
-                stats,
-                step=iter_count,
-                phase=getattr(self.trainer, "health_phase_id", None),
-            )
-        if getattr(self.trainer, "logger", None) is not None:
-            self.trainer.logger.log(stats, step=iter_count)
+        stats.update(self._finish_collect_stats(
+            clock, collected, all_scores, generate_time, dispatch_time,
+            score_time, iter_count,
+        ))
         return stats

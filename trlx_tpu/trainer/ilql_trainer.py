@@ -78,6 +78,14 @@ class ILQLTrainer(BaseRLTrainer):
         method: ILQLConfig = config.method
         train = config.train
 
+        if (train.rollout or {}).get("engine", "fixed") != "fixed":
+            # ILQL is offline — there is no rollout collect loop for the
+            # continuous engine to drive; refuse instead of no-opping
+            raise NotImplementedError(
+                "train.rollout engine "
+                f"{train.rollout.get('engine')!r} is not supported by "
+                "ILQLTrainer (offline trainer; no rollout engine)"
+            )
         self.mesh = make_mesh(train.mesh)
         self.pp_stages = dict(self.mesh.shape).get("pp", 1)
         self.pp_microbatches = train.pp_microbatches

@@ -222,6 +222,33 @@ class PPOTrainer(BaseRLTrainer):
             getattr(self.model_config, "vocab_size", None),
             provided=set(gen_kwargs),
         )
+        # rollout engine selection (train.rollout; docs/inference.md):
+        # "continuous" drives collection through the slot-admission
+        # engine (trlx_tpu/inference/engine.py) instead of the
+        # fixed-batch sampler; per-row RNG keys make the two engines
+        # per-row token-identical, so the fixed path stays the parity
+        # baseline. Parsed before _build_jitted_fns: per_row_rng changes
+        # the sampler's compiled key plumbing.
+        from trlx_tpu.inference import RolloutEngineConfig
+
+        self.rollout_config = RolloutEngineConfig.from_dict(train.rollout)
+        self.rollout_engine = self.rollout_config.engine
+        if self.rollout_engine == "continuous":
+            self._validate_continuous_engine()
+        if self.rollout_config.rows_per_row_rng:
+            import dataclasses
+
+            self.gen_config = dataclasses.replace(
+                self.gen_config, per_row_rng=True
+            )
+        self._rollout_engine_obj = None
+        # per-row RNG phase state: one phase key (split from self.rng
+        # exactly once per collect phase, lazily) + a row cursor counting
+        # rows in draw order — fold_in(phase_key, draw_index) is each
+        # row's base key on BOTH engines, which is what makes their
+        # rollouts comparable row-by-row
+        self._rollout_phase_key = None
+        self._rollout_row_cursor = 0
         if train.logprob_chunk:
             if train.logprob_chunk < 0:
                 raise ValueError(
@@ -828,6 +855,9 @@ class PPOTrainer(BaseRLTrainer):
             in_shardings=(self.param_shardings, batch_sh, batch_sh, rep),
             out_shardings=batch_sh,
         )
+        # a changed decode budget resizes the engine's KV capacity and
+        # output buffers — rebuild it lazily from the new gen_config
+        self._rollout_engine_obj = None
 
     def _build_jitted_fns(self):
         method: PPOConfig = self.config.method
@@ -983,11 +1013,118 @@ class PPOTrainer(BaseRLTrainer):
             donate_argnums=(0,),
         )
 
+    # --------------------- rollout engine (continuous) ----------------- #
+
+    def _supports_continuous_engine(self) -> bool:
+        """Causal-LM trainers share the engine's apply/cache contract;
+        the seq2seq trainer (encoder/decoder split, cross-KV) overrides
+        to refuse loudly instead of silently running the fixed path."""
+        return True
+
+    def _validate_continuous_engine(self) -> None:
+        if not self._supports_continuous_engine():
+            raise NotImplementedError(
+                f"train.rollout engine 'continuous' is not supported by "
+                f"{type(self).__name__} (causal-LM decode path); use "
+                "engine: fixed"
+            )
+        if self.pp_stages > 1:
+            raise NotImplementedError(
+                "train.rollout engine 'continuous' does not compose with "
+                "a pp mesh axis yet (the engine decodes under plain "
+                "GSPMD; pp decode uses stage-resident KV buffers); use "
+                "engine: fixed or drop the pp axis"
+            )
+        if self.group_size > 1:
+            raise NotImplementedError(
+                "train.rollout engine 'continuous' does not support "
+                "grouped sampling (method.group_size > 1 / GRPO) yet: "
+                "harvest groups complete in finish order, breaking the "
+                "group-contiguity the grouped reward shaping assumes; "
+                "use engine: fixed"
+            )
+
+    def reset_rollout_phase(self) -> None:
+        """Start a fresh rollout phase for per-row RNG: the next sampler
+        or engine call derives a new phase key (ONE split of self.rng,
+        identical across engines) and row indices restart at 0."""
+        self._rollout_phase_key = None
+        self._rollout_row_cursor = 0
+
+    def rollout_phase_key(self):
+        """The phase's per-row RNG base key (lazily split once)."""
+        if self._rollout_phase_key is None:
+            self.rng, self._rollout_phase_key = jax.random.split(self.rng)
+        return self._rollout_phase_key
+
+    def take_row_keys(self, n: int):
+        """[n, 2] per-row keys for the next ``n`` drawn rows (advances
+        the draw cursor) — the fixed sampler's per-row-RNG rng argument."""
+        from trlx_tpu.ops.sampling import make_row_keys
+
+        start = self._rollout_row_cursor
+        self._rollout_row_cursor += n
+        return make_row_keys(
+            self.rollout_phase_key(), np.arange(start, start + n)
+        )
+
+    @property
+    def rollout_engine_obj(self):
+        """The continuous-batching engine, built on first use (after
+        bind_prompt_budget has settled the decode budget)."""
+        if self._rollout_engine_obj is None:
+            self._rollout_engine_obj = self._build_rollout_engine()
+        return self._rollout_engine_obj
+
+    def _build_rollout_engine(self):
+        from trlx_tpu.inference.engine import ContinuousBatchingEngine
+
+        cfg = self.rollout_config
+        chunk = int(
+            getattr(self.config.method, "chunk_size", 0)
+            or self.config.train.batch_size
+        )
+        num_slots = cfg.slots or chunk
+
+        def apply_fn(params, input_ids, attention_mask=None,
+                     position_ids=None, cache=None, cache_index=None,
+                     last_only=False):
+            return self.model.apply(
+                {"params": params},
+                input_ids,
+                attention_mask=attention_mask,
+                position_ids=position_ids,
+                cache=cache,
+                cache_index=cache_index,
+                last_only=last_only,
+            )
+
+        return ContinuousBatchingEngine(
+            apply_fn=apply_fn,
+            init_cache_fn=functools.partial(
+                self.family.init_cache, self.model_config
+            ),
+            gen_config=self.gen_config,
+            query_length=self.query_length,
+            vocab_size=self.model_config.vocab_size,
+            num_slots=num_slots,
+            admit_width=cfg.admit_width,
+            harvest_width=cfg.harvest_width,
+            block_size=cfg.block_size,
+            mesh=self.mesh,
+            param_shardings=self.param_shardings,
+            cache_sharding=self._decode_cache_sharding(),
+            with_values=True,
+        )
+
     # ------------------------------------------------------------------ #
 
     def sample(self, prompt_ids, prompt_mask) -> SampleOutput:
         """Run the compiled rollout sampler on a prompt batch."""
-        self.rng, key = jax.random.split(self.rng)
+        if self.gen_config.per_row_rng:
+            key = self.take_row_keys(prompt_ids.shape[0])
+        else:
+            self.rng, key = jax.random.split(self.rng)
         return self._sample_jit(
             self.rollout_params(), prompt_ids, prompt_mask, key
         )
@@ -1126,6 +1263,10 @@ class PPOTrainer(BaseRLTrainer):
         self._health_phase += 1
         # the legacy lazy cast copy is dead weight once the snapshot exists
         self._rollout_params_cache = None
+        # fresh per-row RNG phase: both rollout engines derive row keys
+        # from the same single split, so a phase collected continuously
+        # is row-comparable to the same phase collected fixed-batch
+        self.reset_rollout_phase()
         self._behavior_params = self._behavior_snapshot_jit(self.state.params)
         self._stream = _StreamedPhase(
             plan,
@@ -1361,6 +1502,9 @@ class PPOTrainer(BaseRLTrainer):
         # phase's device work dispatches
         self._phase_index += 1
         self._phase_profiler.on_phase_start(self._phase_index)
+        # non-streamed collections need the per-row phase reset too
+        # (begin_streamed_phase repeats it harmlessly for streamed ones)
+        self.reset_rollout_phase()
         if self._stream_eligible(iter_count):
             self.begin_streamed_phase(seed=seed)
         try:

@@ -74,6 +74,13 @@ class Seq2SeqPPOTrainer(PPOTrainer):
         # so the flag refuses at construction instead of no-opping
         return False
 
+    def _supports_continuous_engine(self) -> bool:
+        # the continuous engine drives the causal apply/cache contract;
+        # the seq2seq sampler splits encode/decode with cross-KV — a
+        # rollout.engine: continuous config refuses at construction
+        # instead of silently running the fixed path
+        return False
+
     def _validate_pp_mesh(self, config, train) -> None:
         # pp for seq2seq: BOTH trunk stacks pipeline in the update's
         # forwards (`pp_runner.pp_t5_forward`), and (round 4) the rollout

@@ -1,0 +1,40 @@
+"""Self-recording measurement artifacts (repo discipline: results live
+in committed JSON artifacts, not docstring TODOs).
+
+Each A/B script calls :func:`record_latest` after printing its JSON
+line: the artifact keeps ONE dated record per (metric, device_kind) —
+the latest measurement per shape+backend, not a log — so the first
+hardware run of any A/B lands its delta in a reviewable diff
+automatically (the AB_PHASE_OVERLAP.json pattern, PR 6)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict
+
+
+def record_latest(artifact_path: str, record: Dict[str, Any]) -> None:
+    """Insert ``record`` (must carry "metric" and "device_kind") into the
+    JSON-list artifact at ``artifact_path``, replacing any previous
+    record with the same (metric, device_kind); stamps today's date."""
+    try:
+        with open(artifact_path, encoding="utf-8") as fh:
+            history = json.load(fh)
+    except (OSError, ValueError):
+        history = []
+    if not isinstance(history, list) or not all(
+        isinstance(r, dict) for r in history
+    ):
+        # hand-edited/wrong-shaped artifact: start fresh rather than
+        # crash AFTER the measurement already ran
+        history = []
+    dated = dict(record, date=time.strftime("%Y-%m-%d"))
+    history = [
+        r for r in history
+        if (r.get("metric"), r.get("device_kind"))
+        != (record.get("metric"), record.get("device_kind"))
+    ] + [dated]
+    with open(artifact_path, "w", encoding="utf-8") as fh:
+        json.dump(history, fh, indent=2)
+        fh.write("\n")
