@@ -64,10 +64,11 @@ PP_JIT_MISCOMPILE = pytest.mark.xfail(
 )
 
 # the un-quarantined parity tests ride the nightly tier: each is
-# ~20-40 s of compile and tier-1 sits within ~25 s of its 870 s budget
+# ~20-40 s of compile and the tier-1 870 s budget is nearly spent
 # (ROADMAP); the spmd_stack-fixed train path keeps tier-1 coverage via
-# test_e2e_ppo_trains_on_dp_fsdp_pp_mesh + the generic
-# test_pipeline_parallel.py schedule-parity tests
+# test_grpo.py::test_grpo_composes_with_pipeline_parallelism + the
+# generic test_pipeline_parallel.py schedule-parity tests (the e2e PPO
+# pp run moved to nightly in the ISSUE-10 retrim)
 PP_FAMILIES_TIERED = [
     pytest.param(ft, marks=pytest.mark.slow)
     for ft in ("gpt2", "gptj", "gpt_neo", "gpt_neox")
@@ -196,10 +197,12 @@ def test_pp_forward_and_grads_match_plain(model_type):
     )
 
 
-@pytest.mark.parametrize(
-    "virtual",
-    [1, pytest.param(2, marks=pytest.mark.slow)],  # interleaved variant: nightly tier
-)
+@pytest.mark.slow  # nightly tier (ROADMAP tier-1 budget, ISSUE-10 retrim):
+# at ~20 s the heaviest tier-1 call; the pp TRAIN-path (spmd_stack) keeps
+# tier-1 canaries via test_grpo.py::test_grpo_composes_with_pipeline_
+# parallelism (full sample->update e2e on a dp x pp mesh) and the
+# test_pipeline_parallel.py schedule-parity suite
+@pytest.mark.parametrize("virtual", [1, 2])
 def test_e2e_ppo_trains_on_dp_fsdp_pp_mesh(virtual):
     """Full PPO (sample -> ref score -> reward -> sharded update) over a
     dp=2 x fsdp=2 x pp=2 mesh; reward on a trivially learnable task rises.

@@ -108,6 +108,34 @@ def main(argv=None) -> int:
         "--inspect`; exit 1 when any leg fails",
     )
     parser.add_argument(
+        "--chaos-smoke",
+        action="store_true",
+        help="instead of the rule engines: injected-failure self-check "
+        "for the resilience layer (docs/resilience.md) — a clean "
+        "supervised run must stay quiet; a transient checkpoint-I/O "
+        "error must recover via bounded backoff; a structure mismatch "
+        "must refuse fast; a SIGTERM at phase k must drain to an "
+        "emergency checkpoint and auto-resume bitwise-identically; an "
+        "engine-path failure must degrade to the fixed sampler with a "
+        "health event; a disk-full rollout log must degrade to "
+        "synchronous writes with zero row loss; exit 1 when any "
+        "scenario fails",
+    )
+    parser.add_argument(
+        "--chaos-workdir",
+        metavar="DIR",
+        default=None,
+        help="with --chaos-smoke: scratch/artifact directory for the "
+        "scenarios' checkpoints and logs (default: a temp dir)",
+    )
+    parser.add_argument(
+        "--chaos-scenarios",
+        metavar="NAMES",
+        default=None,
+        help="with --chaos-smoke: comma-separated subset of scenarios "
+        "to run (default: all)",
+    )
+    parser.add_argument(
         "--health-dump-dir",
         metavar="DIR",
         default=None,
@@ -239,6 +267,27 @@ def main(argv=None) -> int:
             # partial relock) and nothing was written
             return 1 if report.findings else 0
         return report.exit_code(strict=args.strict)
+
+    if args.chaos_smoke:
+        _force_cpu_platform()
+        import json as _json
+
+        from trlx_tpu.analysis.chaos_smoke import (
+            format_smoke_text,
+            run_chaos_smoke,
+        )
+
+        only = (
+            [s.strip() for s in args.chaos_scenarios.split(",") if s.strip()]
+            if args.chaos_scenarios
+            else None
+        )
+        summary = run_chaos_smoke(workdir=args.chaos_workdir, only=only)
+        if args.json:
+            print(_json.dumps(summary, default=str))
+        else:
+            print(format_smoke_text(summary))
+        return 0 if summary["passed"] else 1
 
     if args.health_smoke:
         _force_cpu_platform()

@@ -1,0 +1,418 @@
+"""``--chaos-smoke``: injected-failure self-check for the resilience layer.
+
+The ``--health-smoke`` pattern applied to recovery: a fault-tolerance
+subsystem that cannot survive a *planted* failure is vacuous exactly
+when it breaks. Each scenario runs a REAL tiny training job (the
+`tests/test_resume.py` harness shape) with one failure injected through
+the chaos schedule (resilience/chaos.py) and asserts the specified
+recovery — no mocks anywhere on the failure path:
+
+1. **clean** — resilience armed, no chaos: the run completes with zero
+   chaos events, zero retries, zero restarts (the supervisor must be
+   inert when nothing fails);
+2. **transient checkpoint I/O** — ``checkpoint.save`` fails twice: the
+   bounded-backoff retry absorbs it with zero user-visible failure and
+   the checkpoint lands;
+3. **permanent structure mismatch** — a real orbax layout disagreement
+   AND an injected permanent error both refuse fast: exactly one
+   attempt, an actionable ValueError;
+4. **preemption at phase k** — a real SIGTERM delivered at phase 0's
+   boundary: emergency checkpoint, supervised auto-resume, and a final
+   state **bitwise identical** to the uninterrupted run (params + step +
+   KL state);
+5. **engine-path failure** — ``engine.admit`` fails under the
+   continuous rollout engine: the phase completes on the fixed sampler
+   with an ``engine-fallback`` health event, not an abort;
+6. **async-writer disk-full** — three consecutive ENOSPC on the rollout
+   log: the writer degrades to synchronous writes, and every row is
+   durable once the disk recovers.
+
+PASS requires every scenario. Exercised per-PR by the ``chaos-smoke``
+CI job (`python -m trlx_tpu.analysis --chaos-smoke --json`).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Callable, Dict, List
+
+SCENARIOS = (
+    "clean",
+    "transient_checkpoint_io",
+    "permanent_mismatch",
+    "preempt_resume_parity",
+    "engine_fallback",
+    "writer_disk_full",
+)
+
+
+def tiny_config_dict(
+    checkpoint_dir: str,
+    total_steps: int,
+    resilience: Dict[str, Any],
+    **train_overrides: Any,
+) -> Dict[str, Any]:
+    """The test_resume harness shape: 1-layer/16-wide gpt2, 2-step
+    phases (num_rollouts=16, batch=8, ppo_epochs=1) — every scenario
+    below preempts/resumes/fails on phase boundaries of this layout."""
+    train = {
+        "seq_length": 4,
+        "batch_size": 8,
+        "epochs": 8,
+        "total_steps": total_steps,
+        "eval_interval": 10000,
+        "checkpoint_interval": 100000,
+        "checkpoint_dir": checkpoint_dir,
+        "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+        "dtype": "float32",
+        "resilience": resilience,
+    }
+    train.update(train_overrides)
+    return {
+        "model": {
+            "model_type": "gpt2",
+            "model_arch": {
+                "vocab_size": 32,
+                "n_positions": 16,
+                "n_embd": 16,
+                "n_layer": 1,
+                "n_head": 2,
+            },
+        },
+        "train": train,
+        "method": {
+            "name": "PPOConfig",
+            "num_rollouts": 16,
+            "chunk_size": 8,
+            "ppo_epochs": 1,
+            "gen_kwargs": {
+                "max_new_tokens": 2,
+                "do_sample": True,
+                "eos_token_id": 30,
+                "pad_token_id": 31,
+            },
+        },
+    }
+
+
+def _train(config_dict: Dict[str, Any]):
+    import contextlib
+    import sys
+
+    import numpy as np
+
+    import trlx_tpu
+    from trlx_tpu.data.configs import TRLConfig
+
+    os.environ["WANDB_DISABLED"] = "1"
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 30, size=3)) for _ in range(16)]
+    # the Logger's per-step JSON lines go to stdout; reroute them to
+    # stderr so the smoke's own report (CI tees stdout into the
+    # artifact) stays a single parseable JSON document
+    with contextlib.redirect_stdout(sys.stderr):
+        return trlx_tpu.train(
+            reward_fn=lambda samples, queries, response_gt=None: [
+                float(len(s)) for s in samples
+            ],
+            prompts=prompts,
+            config=TRLConfig.from_dict(config_dict),
+        )
+
+
+#: retry overrides for the smoke: real backoff shape, test-speed delays
+FAST_RETRY = {"max_attempts": 4, "base_delay_s": 0.01, "max_delay_s": 0.05}
+
+
+def scenario_clean(workdir: str) -> Dict[str, Any]:
+    from trlx_tpu.resilience import chaos
+    from trlx_tpu.utils.retry import retry_log
+
+    trainer = _train(
+        tiny_config_dict(
+            os.path.join(workdir, "ckpt"), total_steps=4,
+            resilience={"enabled": True},
+        )
+    )
+    return {
+        "final_step": int(trainer.state.step),
+        "chaos_events": len(chaos.events()),
+        "retries": len(retry_log),
+        "passed": (
+            int(trainer.state.step) == 4
+            and not chaos.events()
+            and not retry_log
+        ),
+    }
+
+
+def scenario_transient_checkpoint_io(workdir: str) -> Dict[str, Any]:
+    from trlx_tpu.utils.checkpoint import has_checkpoint
+    from trlx_tpu.utils.retry import retry_log
+
+    ckpt = os.path.join(workdir, "ckpt")
+    trainer = _train(
+        tiny_config_dict(
+            ckpt, total_steps=2,
+            resilience={
+                "enabled": True,
+                "retry": dict(FAST_RETRY),
+                "chaos": [
+                    {"site": "checkpoint.save", "mode": "error", "count": 2}
+                ],
+            },
+        )
+    )
+    save_retries = [
+        r for r in retry_log if "checkpoint save" in r["what"]
+    ]
+    return {
+        "final_step": int(trainer.state.step),
+        "save_retries": len(save_retries),
+        "checkpoint_exists": has_checkpoint(ckpt),
+        "passed": (
+            int(trainer.state.step) == 2
+            and len(save_retries) == 2  # failed twice, succeeded third
+            and has_checkpoint(ckpt)
+        ),
+    }
+
+
+def scenario_permanent_mismatch(workdir: str) -> Dict[str, Any]:
+    """Both flavors of permanent: a REAL orbax structure mismatch and an
+    injected one — neither may consume a retry."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.resilience import chaos
+    from trlx_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+    from trlx_tpu.utils.retry import reset_retry_log, retry_log
+
+    d = os.path.join(workdir, "ckpt")
+    save_checkpoint(
+        d,
+        {"a": jnp.zeros((4,)), "b": jnp.ones((4,))},
+        metadata={"kl_coef": 0.1},
+    )
+
+    real_refused = injected_refused = False
+    real_error = injected_error = ""
+    reset_retry_log()
+    try:
+        # restore under a different train-state structure: must refuse
+        # fast with the actionable translation, not die deep in orbax
+        # and not retry
+        load_checkpoint(d, {"a": jnp.zeros((4,))})
+    except ValueError as e:
+        real_refused = True
+        real_error = str(e)[:160]
+    except Exception as e:  # wrong type = taxonomy failure
+        real_error = f"{type(e).__name__}: {e}"[:160]
+    real_no_retry = not retry_log
+
+    chaos.configure(
+        [{"site": "checkpoint.load", "mode": "permanent", "count": 1}]
+    )
+    try:
+        load_checkpoint(d, {"a": jnp.zeros((4,)), "b": jnp.ones((4,))})
+    except ValueError as e:
+        injected_refused = True
+        injected_error = str(e)[:160]
+    except Exception as e:
+        injected_error = f"{type(e).__name__}: {e}"[:160]
+    finally:
+        chaos.clear()
+    injected_no_retry = not retry_log
+
+    return {
+        "real_refused_fast": real_refused and real_no_retry,
+        "real_error": real_error,
+        "injected_refused_fast": injected_refused and injected_no_retry,
+        "injected_error": injected_error,
+        "passed": (
+            real_refused
+            and real_no_retry
+            and injected_refused
+            and injected_no_retry
+        ),
+    }
+
+
+def scenario_preempt_resume_parity(workdir: str) -> Dict[str, Any]:
+    import jax
+    import numpy as np
+
+    # run A: uninterrupted, 3 phases
+    a = _train(
+        tiny_config_dict(
+            os.path.join(workdir, "ckpt_a"), total_steps=6,
+            resilience={"enabled": True},
+        )
+    )
+    ref_params = jax.device_get(a.state.params)
+    ref_step = int(a.state.step)
+    ref_kl = float(jax.device_get(a.kl_coef))
+    del a
+
+    # run B: SIGTERM delivered at phase 0's boundary (a REAL signal via
+    # os.kill) — drain writes the emergency checkpoint, the supervisor
+    # restarts resuming from it, and the run must land bitwise where A
+    # did
+    b = _train(
+        tiny_config_dict(
+            os.path.join(workdir, "ckpt_b"), total_steps=6,
+            resilience={
+                "enabled": True,
+                "chaos": [
+                    {"site": "preempt", "mode": "preempt", "phase": 0}
+                ],
+            },
+        )
+    )
+    cur_params = jax.device_get(b.state.params)
+    bitwise = all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(
+            jax.tree_util.tree_leaves(ref_params),
+            jax.tree_util.tree_leaves(cur_params),
+        )
+    )
+    kl_equal = float(jax.device_get(b.kl_coef)) == ref_kl
+    return {
+        "final_step": int(b.state.step),
+        "params_bitwise_equal": bitwise,
+        "kl_coef_equal": kl_equal,
+        "passed": (
+            # asserted on outcomes: the phase-0 preempt spec fires
+            # deterministically, so a run that completed at the right
+            # step with bitwise parity can only have gotten there
+            # through drain -> emergency checkpoint -> supervised resume
+            int(b.state.step) == ref_step
+            and bitwise
+            and kl_equal
+        ),
+    }
+
+
+def scenario_engine_fallback(workdir: str) -> Dict[str, Any]:
+    trainer = _train(
+        tiny_config_dict(
+            os.path.join(workdir, "ckpt"), total_steps=2,
+            resilience={
+                "enabled": True,
+                "chaos": [
+                    {"site": "engine.admit", "mode": "error", "count": 1}
+                ],
+            },
+            rollout={"engine": "continuous"},
+            health={"enabled": True},
+        )
+    )
+    counts = (
+        trainer.health_monitor.event_counts
+        if trainer.health_monitor is not None
+        else {}
+    )
+    return {
+        "final_step": int(trainer.state.step),
+        "engine_after": trainer.rollout_engine,
+        "fallback_events": counts.get("engine-fallback", 0),
+        "passed": (
+            int(trainer.state.step) == 2
+            and trainer.rollout_engine == "fixed"
+            and counts.get("engine-fallback", 0) == 1
+        ),
+    }
+
+
+def scenario_writer_disk_full(workdir: str) -> Dict[str, Any]:
+    import json
+
+    log_dir = os.path.join(workdir, "rollouts")
+    trainer = _train(
+        tiny_config_dict(
+            os.path.join(workdir, "ckpt"), total_steps=2,
+            resilience={
+                "enabled": True,
+                "chaos": [
+                    # three consecutive ENOSPC: enough to trip the
+                    # degrade threshold, then the "disk" recovers
+                    {"site": "writer.write", "mode": "disk_full",
+                     "count": 3}
+                ],
+            },
+            rollout_logging_dir=log_dir,
+        )
+    )
+    rows = []
+    for root, _, files in os.walk(log_dir):
+        for name in sorted(files):
+            with open(os.path.join(root, name)) as f:
+                rows += [json.loads(line) for line in f]
+    return {
+        "final_step": int(trainer.state.step),
+        "rows_durable": len(rows),
+        "passed": int(trainer.state.step) == 2 and len(rows) == 16,
+    }
+
+
+_SCENARIO_FNS: Dict[str, Callable[[str], Dict[str, Any]]] = {
+    "clean": scenario_clean,
+    "transient_checkpoint_io": scenario_transient_checkpoint_io,
+    "permanent_mismatch": scenario_permanent_mismatch,
+    "preempt_resume_parity": scenario_preempt_resume_parity,
+    "engine_fallback": scenario_engine_fallback,
+    "writer_disk_full": scenario_writer_disk_full,
+}
+
+
+def run_chaos_smoke(
+    workdir: str = None, only: List[str] = None
+) -> Dict[str, Any]:
+    """Run the scenarios; returns a JSON-able summary with ``passed``."""
+    from trlx_tpu.resilience import chaos
+    from trlx_tpu.utils.retry import reset_retry_log
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos-smoke-")
+    names = list(only or SCENARIOS)
+    unknown = set(names) - set(_SCENARIO_FNS)
+    if unknown:
+        raise ValueError(
+            f"unknown chaos-smoke scenario(s) {sorted(unknown)}; "
+            f"known: {list(SCENARIOS)}"
+        )
+    results: Dict[str, Dict[str, Any]] = {}
+    for name in names:
+        chaos.clear()
+        reset_retry_log()
+        scenario_dir = os.path.join(workdir, name)
+        os.makedirs(scenario_dir, exist_ok=True)
+        try:
+            results[name] = _SCENARIO_FNS[name](scenario_dir)
+        except Exception as e:  # a scenario crash is a FAIL, not a crash
+            results[name] = {
+                "passed": False,
+                "error": f"{type(e).__name__}: {e}",
+            }
+        finally:
+            chaos.clear()
+            reset_retry_log()
+    return {
+        "passed": all(r.get("passed") for r in results.values()),
+        "scenarios": results,
+        "workdir": workdir,
+    }
+
+
+def format_smoke_text(summary: Dict[str, Any]) -> str:
+    lines = []
+    for name, result in summary["scenarios"].items():
+        status = "PASS" if result.get("passed") else "FAIL"
+        detail = ", ".join(
+            f"{k}={v}" for k, v in result.items() if k != "passed"
+        )
+        lines.append(f"{status}  {name}: {detail}")
+    lines.append(
+        "chaos-smoke: " + ("PASS" if summary["passed"] else "FAIL")
+    )
+    return "\n".join(lines)

@@ -63,67 +63,84 @@ def train(
             )
         if model_path:
             config.model.model_path = model_path
-        trainer = get_trainer(config.train.trainer)(
-            config,
-            reward_fn=reward_fn,
-            metric_fn=metric_fn,
-            tokenizer=tokenizer,
-            logit_mask=logit_mask,
-        )
         if prompts is None:
             raise ValueError("online PPO requires `prompts`")
-        pipeline = get_pipeline(config.train.pipeline)(
-            prompts,
-            trainer.query_length,
-            trainer.tokenizer,
-            response_gt=response_gt,
-        )
-        orch = get_orchestrator(config.train.orchestrator)(
-            trainer,
-            pipeline,
-            reward_fn=reward_fn,
-            chunk_size=config.method.chunk_size,
-        )
 
-        if eval_prompts is None:
-            # reuse the training pipeline (same prompts, same ground
-            # truths — the reference's eval passes response_gt to the
-            # reward fn, `accelerate_base_model.py:193`); create_loader
-            # returns independent generators, so sharing the object is safe
-            # and skips a second tokenize/decode pass over every prompt
-            eval_pipeline = pipeline
-        else:
-            # caller-supplied eval prompts carry no aligned gt list
-            eval_pipeline = get_pipeline(config.train.pipeline)(
-                eval_prompts, trainer.query_length, trainer.tokenizer
+        # One supervised attempt: build trainer/pipeline/orchestrator
+        # fresh (after a failure, mid-phase state is assumed poisoned)
+        # and run learn(). The resilience supervisor
+        # (`train.resilience`, docs/resilience.md) restarts this on
+        # retriable failures/preemptions, resuming from the latest good
+        # checkpoint; disabled (the default) it runs exactly once.
+        def attempt(resume: bool):
+            config.train.resume_from_checkpoint = bool(resume)
+            trainer = get_trainer(config.train.trainer)(
+                config,
+                reward_fn=reward_fn,
+                metric_fn=metric_fn,
+                tokenizer=tokenizer,
+                logit_mask=logit_mask,
             )
-        # bind eval BEFORE the first collection: add_eval_pipeline may
-        # expand the decode budget (bind_prompt_budget), and doing so after
-        # make_experience would discard the just-compiled sampler.
-        trainer.add_eval_pipeline(eval_pipeline)
-        # The first collection is learn()'s (it collects when the buffer
-        # is empty): that way it runs as a streamed phase with epoch-1
-        # updates overlapping the decode (docs/async_pipeline.md) instead
-        # of a plain serial pre-collection here, and a resumed-finished
-        # run skips collection entirely.
-        # stop the background rollout writer when learn() finishes; a
-        # write error the phase-end drain-on-exception flush swallowed
-        # surfaces here — suppressed only when learn() itself is raising
-        # (try/except/else rather than sys.exc_info() in a finally: the
-        # latter also sees an *enclosing caller's* in-flight exception
-        # and would silently drop the error on a successful run)
-        try:
-            trainer.learn()
-        except BaseException as e:
-            # crash forensics for failures that escape learn()'s own
-            # epilogue (e.g. a collect failure re-raised after the
-            # stream abort): at most one flight dump per run — a no-op
-            # when learn() already dumped or health is off
-            trainer.flight_dump_on_exception(e)
-            orch.close(reraise=False)
-            raise
-        orch.close()
-        return trainer
+            pipeline = get_pipeline(config.train.pipeline)(
+                prompts,
+                trainer.query_length,
+                trainer.tokenizer,
+                response_gt=response_gt,
+            )
+            orch = get_orchestrator(config.train.orchestrator)(
+                trainer,
+                pipeline,
+                reward_fn=reward_fn,
+                chunk_size=config.method.chunk_size,
+            )
+
+            if eval_prompts is None:
+                # reuse the training pipeline (same prompts, same ground
+                # truths — the reference's eval passes response_gt to the
+                # reward fn, `accelerate_base_model.py:193`); create_loader
+                # returns independent generators, so sharing the object is
+                # safe and skips a second tokenize/decode pass over every
+                # prompt
+                eval_pipeline = pipeline
+            else:
+                # caller-supplied eval prompts carry no aligned gt list
+                eval_pipeline = get_pipeline(config.train.pipeline)(
+                    eval_prompts, trainer.query_length, trainer.tokenizer
+                )
+            # bind eval BEFORE the first collection: add_eval_pipeline may
+            # expand the decode budget (bind_prompt_budget), and doing so
+            # after make_experience would discard the just-compiled
+            # sampler.
+            trainer.add_eval_pipeline(eval_pipeline)
+            # The first collection is learn()'s (it collects when the
+            # buffer is empty): that way it runs as a streamed phase with
+            # epoch-1 updates overlapping the decode
+            # (docs/async_pipeline.md) instead of a plain serial
+            # pre-collection here, and a resumed-finished run skips
+            # collection entirely.
+            # stop the background rollout writer when learn() finishes; a
+            # write error the phase-end drain-on-exception flush swallowed
+            # surfaces here — suppressed only when learn() itself is
+            # raising (try/except/else rather than sys.exc_info() in a
+            # finally: the latter also sees an *enclosing caller's*
+            # in-flight exception and would silently drop the error on a
+            # successful run)
+            try:
+                trainer.learn()
+            except BaseException as e:
+                # crash forensics for failures that escape learn()'s own
+                # epilogue (e.g. a collect failure re-raised after the
+                # stream abort): at most one flight dump per run — a no-op
+                # when learn() already dumped or health is off
+                trainer.flight_dump_on_exception(e)
+                orch.close(reraise=False)
+                raise
+            orch.close()
+            return trainer
+
+        from trlx_tpu.resilience.supervisor import run_supervised
+
+        return run_supervised(attempt, config)
 
     elif dataset is not None:
         samples, rewards = dataset
@@ -145,16 +162,6 @@ def train(
             config.train.trainer = "ILQLTrainer"
         if config.train.orchestrator != "OfflineOrchestrator":
             config.train.orchestrator = "OfflineOrchestrator"
-        trainer = get_trainer(config.train.trainer)(
-            config,
-            metric_fn=metric_fn,
-            tokenizer=tokenizer,
-            logit_mask=logit_mask,
-        )
-        orch = get_orchestrator(config.train.orchestrator)(
-            trainer, split_token=split_token
-        )
-        orch.make_experience(samples, rewards)
 
         if eval_prompts is None:
             # derive eval prompts from the samples' prompt portions:
@@ -169,13 +176,33 @@ def train(
                 else:
                     toks, start = s
                     eval_prompts.append([int(t) for t in toks[: max(int(start), 1)]])
-        eval_pipeline = get_pipeline(config.train.pipeline)(
-            eval_prompts,
-            trainer.query_length,
-            trainer.tokenizer,
-        )
-        trainer.add_eval_pipeline(eval_pipeline)
-        trainer.learn()
-        return trainer
+
+        # same supervised-attempt shape as the PPO branch: the offline
+        # path has no rollout engine, but preemption drain + checkpoint
+        # I/O retries + bounded auto-resume apply unchanged
+        def attempt(resume: bool):
+            config.train.resume_from_checkpoint = bool(resume)
+            trainer = get_trainer(config.train.trainer)(
+                config,
+                metric_fn=metric_fn,
+                tokenizer=tokenizer,
+                logit_mask=logit_mask,
+            )
+            orch = get_orchestrator(config.train.orchestrator)(
+                trainer, split_token=split_token
+            )
+            orch.make_experience(samples, rewards)
+            eval_pipeline = get_pipeline(config.train.pipeline)(
+                eval_prompts,
+                trainer.query_length,
+                trainer.tokenizer,
+            )
+            trainer.add_eval_pipeline(eval_pipeline)
+            trainer.learn()
+            return trainer
+
+        from trlx_tpu.resilience.supervisor import run_supervised
+
+        return run_supervised(attempt, config)
 
     raise ValueError("Either `reward_fn` (PPO) or `dataset` (ILQL) is required")

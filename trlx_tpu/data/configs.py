@@ -152,6 +152,18 @@ class TrainConfig:
     # at the END of exactly phase N, on demand — crash dumps need no flag;
     # requires health.enabled
     flight_dump_phase: Optional[int] = None
+    # Fault tolerance (trlx_tpu/resilience, docs/resilience.md):
+    # {"enabled": true, "max_restarts": 2, "resume_on_preemption": true,
+    #  "preempt_signals": ["SIGTERM", "SIGINT"], "restart_delay_s": 0.0,
+    #  "retry": {"max_attempts": ..., "base_delay_s": ...},
+    #  "chaos": [{"site": ..., "mode": ..., "phase": ..., "count": ...}]}.
+    # With enabled, api.train runs under the resilience supervisor: a
+    # SIGTERM/SIGINT drains gracefully at the next phase boundary
+    # (emergency atomic checkpoint + flight dump, exit code 75), and
+    # retriable failures (transient I/O, HealthAbort, preemption) restart
+    # from the latest good checkpoint within a bounded restart budget.
+    # Default off: no signal handlers are installed and nothing changes.
+    resilience: Dict[str, Any] = field(default_factory=dict)
     project_name: str = "trlx_tpu"
     run_name: str = ""
     seed: int = 1000

@@ -554,7 +554,13 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt_ids, prompt_mask) -> List[int]:
         """Enqueue prompts (host arrays, [n, Q]); returns their global
-        row indices (draw order — the per-row RNG identity)."""
+        row indices (draw order — the per-row RNG identity). Carries
+        the ``engine.admit`` fault-injection site (resilience/chaos.py):
+        an injected admission failure drives the orchestrator's
+        fixed-sampler fallback and the server's admission retry."""
+        from trlx_tpu.resilience import chaos
+
+        chaos.check("engine.admit")
         ids = np.asarray(prompt_ids)
         mask = np.asarray(prompt_mask)
         if ids.ndim != 2 or ids.shape[1] != self.Q:

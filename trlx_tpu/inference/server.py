@@ -236,7 +236,16 @@ class InferenceServer:
                 )
             ids[i, Q - len(toks):] = toks  # left-pad, as the trainer does
             mask[i, Q - len(toks):] = 1
-        rows = self.engine.submit(ids, mask)
+        # admission is host-side bookkeeping, but it sits on the serving
+        # request path — a transient failure (the engine.admit injection
+        # site models one) retries with bounded backoff instead of
+        # bouncing the request (docs/resilience.md)
+        from trlx_tpu.utils.retry import retry_call
+
+        rows = retry_call(
+            lambda: self.engine.submit(ids, mask),
+            describe="inference-server admission",
+        )
         for r in rows:
             self._open[r] = True
         self._last_prompt = (ids[-1].copy(), mask[-1].copy())

@@ -20,8 +20,9 @@ in the rollout loop"):
 from __future__ import annotations
 
 import os
+import sys
 import time
-from typing import Callable
+from typing import Any, Callable, Dict
 
 import numpy as np
 
@@ -76,6 +77,12 @@ class PPOOrchestrator(Orchestrator):
                 drop_last=False,
             )
         )
+        # prompt draws since construction: the infinite stream's position
+        # is run-cumulative, so it is checkpointed (state_dict) and
+        # fast-forwarded on resume — without it a resumed run replays
+        # prompts from the beginning and diverges from the run it
+        # continues (kill/resume parity, docs/resilience.md)
+        self._draws = 0
         # running reward scaling state (`ppo_orchestrator.py:49-51`)
         self.running = RunningMoments()
         self.ref_mean = trainer.config.method.ref_mean
@@ -93,6 +100,42 @@ class PPOOrchestrator(Orchestrator):
             from trlx_tpu.utils.async_writer import BackgroundJSONLWriter
 
             self._rollout_writer = BackgroundJSONLWriter()
+
+    def _draw(self):
+        """One prompt-batch draw from the infinite stream (counted for
+        checkpoint/resume)."""
+        self._draws += 1
+        return next(self._loader)
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Host-side collection state that must survive a checkpoint
+        round trip for a resumed run to continue the same trajectory:
+        reward-scaling moments (`RunningMoments`), the reference stats,
+        and the prompt-stream position."""
+        return {
+            "running": {
+                "mean": self.running.mean,
+                "std": self.running.std,
+                "var": self.running.var,
+                "count": self.running.count,
+            },
+            "ref_mean": self.ref_mean,
+            "ref_std": self.ref_std,
+            "prompt_draws": self._draws,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        running = state.get("running") or {}
+        for key in ("mean", "std", "var", "count"):
+            if key in running:
+                setattr(self.running, key, float(running[key]))
+        self.ref_mean = state.get("ref_mean", self.ref_mean)
+        self.ref_std = state.get("ref_std", self.ref_std)
+        # fast-forward the deterministic prompt stream to the saved
+        # position (draws are host-side index shuffles — cheap)
+        target = int(state.get("prompt_draws", 0))
+        while self._draws < target:
+            self._draw()
 
     def close(self, reraise: bool = True) -> None:
         """Stop the rollout writer, draining queued rows; a write error a
@@ -189,7 +232,7 @@ class PPOOrchestrator(Orchestrator):
         without waiting on it. Dispatch is async; the results are consumed
         later, after the *previous* chunk's host-side scoring."""
         with telemetry.span("collect/prompt_draw"):
-            batch, meta = next(self._loader)
+            batch, meta = self._draw()
         batch, meta = self._expand_groups(batch, meta)
         # forced span: its duration IS exp/dispatch_time's increment, so
         # the stat survives a disabled tracer (span measures, won't record)
@@ -223,10 +266,64 @@ class PPOOrchestrator(Orchestrator):
         configured rollout engine (``train.rollout``): the fixed-batch
         double-buffered chunk loop (the default and parity baseline), or
         the continuous-batching slot-admission engine
-        (docs/inference.md)."""
+        (docs/inference.md). An engine-path failure degrades gracefully
+        to the fixed sampler — a health event and a restarted phase, not
+        an aborted run (docs/resilience.md)."""
         if getattr(self.trainer, "rollout_engine", "fixed") == "continuous":
-            return self._make_experience_continuous(num_rollouts, iter_count)
+            try:
+                return self._make_experience_continuous(
+                    num_rollouts, iter_count
+                )
+            except Exception as e:
+                from trlx_tpu.resilience.preemption import PreemptionDrain
+                from trlx_tpu.telemetry.health import HealthAbort
+
+                if isinstance(e, (HealthAbort, PreemptionDrain)):
+                    raise  # policy decisions, not engine-path failures
+                self._degrade_engine(e, iter_count)
         return self._make_experience_fixed(num_rollouts, iter_count)
+
+    def _degrade_engine(self, error: BaseException, iter_count: int) -> None:
+        """Fall back from the continuous engine to the fixed sampler for
+        the rest of the run: flip the trainer's engine selection (the
+        fixed sampler is always compiled — evaluation uses it), emit an
+        ``engine-fallback`` health event (warning severity: degradation
+        is the alternative to the abort policy, never its trigger), and
+        restart the current phase cleanly — partial harvests landed by
+        the failed engine phase cannot satisfy the stream plan. Epoch-1
+        updates the partial phase already dispatched are not rolled
+        back, exactly like :meth:`PPOTrainer.abort_streamed_phase`."""
+        tr = self.trainer
+        print(
+            "resilience: continuous rollout engine failed "
+            f"({type(error).__name__}: {error}) — falling back to the "
+            "fixed sampler for the rest of the run",
+            file=sys.stderr,
+        )
+        tr.rollout_engine = "fixed"
+        tr._rollout_engine_obj = None  # drop the poisoned slot pool
+        emit = getattr(tr, "emit_health_event", None)
+        if emit is not None:
+            emit(
+                detector="engine-fallback",
+                severity="warning",
+                series="engine",
+                message=(
+                    "continuous rollout engine failed "
+                    f"({type(error).__name__}: {error}); degraded to the "
+                    "fixed sampler"
+                ),
+                step=iter_count,
+                phase=getattr(tr, "health_phase_id", None),
+            )
+        if getattr(tr, "_stream", None) is not None:
+            seed = getattr(tr, "_last_stream_seed", 0)
+            tr.abort_streamed_phase()
+            tr.begin_streamed_phase(seed=seed)
+        else:
+            tr.buffer.clear_history()
+            if hasattr(tr, "reset_rollout_phase"):
+                tr.reset_rollout_phase()
 
     def _finish_collect_stats(
         self,
@@ -311,7 +408,7 @@ class PPOOrchestrator(Orchestrator):
                     # (row index = draw order = the per-row RNG identity)
                     while engine.pending + engine.stats.completed < target:
                         with telemetry.span("collect/prompt_draw"):
-                            batch, meta = next(self._loader)
+                            batch, meta = self._draw()
                         batch, meta = self._expand_groups(batch, meta)
                         rows = engine.submit(
                             np.asarray(batch.input_ids),

@@ -1,0 +1,34 @@
+"""Fault tolerance: chaos injection, preemption drain, supervised resume.
+
+The recovery half of the robustness loop (PR 7 built the detection
+half — health detectors + flight recorder). Four layers, one subsystem
+(docs/resilience.md):
+
+- :mod:`trlx_tpu.resilience.chaos` — deterministic fault injection at
+  named host-side sites (the ``--chaos-smoke`` self-check proves every
+  recovery path below against injected failures);
+- :mod:`trlx_tpu.utils.retry` — the transient-vs-permanent error
+  taxonomy + bounded-backoff retry wrapped around checkpoint I/O,
+  rollout-log writes, wandb emission, and server admission;
+- :mod:`trlx_tpu.resilience.preemption` — SIGTERM/SIGINT → graceful
+  drain at the next phase boundary (emergency atomic checkpoint +
+  flight dump + distinct exit code);
+- :mod:`trlx_tpu.resilience.supervisor` — ``train.resilience``-driven
+  bounded auto-resume from the latest good checkpoint (imported lazily
+  by `api.train`; import it as ``trlx_tpu.resilience.supervisor`` to
+  avoid cycles with `utils/checkpoint.py`).
+
+This package must stay import-light: `utils/checkpoint.py` imports
+:mod:`.chaos` at module load.
+"""
+
+from trlx_tpu.resilience import chaos  # noqa: F401
+from trlx_tpu.resilience.preemption import (  # noqa: F401
+    PREEMPTION_EXIT_CODE,
+    PreemptionDrain,
+    PreemptionGuard,
+    clear_request,
+    drain_requested,
+    install_guard,
+    uninstall_guard,
+)

@@ -1,0 +1,275 @@
+"""Deterministic fault injection at named host-side sites.
+
+A recovery path that is never exercised is broken exactly when it is
+needed — the ``--plant-nan`` / ``--health-smoke`` planted-anomaly
+pattern, applied to failures themselves. Production code calls
+:func:`check` at each *injection site*; with no specs configured the
+call is a list-emptiness test (zero cost, always on). A configured spec
+fires at its site — matched by ``(site, phase, step)`` so every
+scenario is reproducible — for exactly ``count`` triggers, then goes
+quiet, which is how a *transient* failure (fails twice, then the
+filesystem recovers) is modeled deterministically.
+
+Injection-site catalog (docs/resilience.md):
+
+==================  ====================================================
+site                where / what a spec injects
+==================  ====================================================
+``checkpoint.save`` `utils/checkpoint.py::save_checkpoint` — I/O error
+                    before the orbax write (``error`` = transient OSError,
+                    ``permanent`` = structure-mismatch ValueError)
+``checkpoint.load`` `utils/checkpoint.py::load_checkpoint` — same modes
+                    on the restore path
+``writer.write``    the background JSONL writer's file append
+                    (``disk_full`` = ENOSPC)
+``engine.admit``    `inference/engine.py::submit` — admission failure on
+                    the continuous rollout engine / inference server
+``logger.emit``     `utils/logging.py` wandb emission
+``preempt``         trainer phase boundary — delivers a real SIGTERM to
+                    this process (the preemption drain then runs)
+``slow_step``       trainer phase boundary — host-side ``stall`` of
+                    ``delay_s`` seconds
+==================  ====================================================
+
+Specs come from :func:`configure` (the supervisor passes
+``train.resilience.chaos`` through) or the ``TRLX_CHAOS`` environment
+variable (a JSON list of spec dicts) so any entry point can be put
+under chaos without code changes. Every firing is recorded in
+:func:`events` for the ``--chaos-smoke`` self-check.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+SITES = (
+    "checkpoint.save",
+    "checkpoint.load",
+    "writer.write",
+    "engine.admit",
+    "logger.emit",
+    "preempt",
+    "slow_step",
+)
+
+MODES = ("error", "permanent", "disk_full", "preempt", "stall")
+
+#: env var holding a JSON list of spec dicts, merged at configure time
+ENV_VAR = "TRLX_CHAOS"
+
+
+class ChaosInjectedIOError(OSError):
+    """Injected transient I/O failure (classified transient by the
+    `utils/retry.py` taxonomy via its OSError base, not by type)."""
+
+
+class ChaosInjectedStructureError(ValueError):
+    """Injected permanent failure; the message carries the orbax
+    structure-mismatch phrasing so `utils/checkpoint.py` translates it
+    exactly like the real thing."""
+
+
+@dataclass
+class ChaosSpec:
+    """One scheduled injection.
+
+    :param site: injection-site name (see :data:`SITES`).
+    :param mode: ``error`` (transient OSError), ``permanent``
+        (structure-mismatch ValueError), ``disk_full`` (ENOSPC),
+        ``preempt`` (SIGTERM to this process), ``stall`` (host sleep of
+        ``delay_s``).
+    :param phase: fire only when the site reports this phase index
+        (None = any phase).
+    :param step: fire only at this step (None = any).
+    :param count: total triggers before the spec goes quiet — a
+        transient failure that recovers is ``count=2`` against a retry
+        budget of 3+.
+    :param delay_s: stall duration for ``mode="stall"``.
+    """
+
+    site: str
+    mode: str = "error"
+    phase: Optional[int] = None
+    step: Optional[int] = None
+    count: int = 1
+    delay_s: float = 0.0
+    remaining: int = field(init=False, default=0)
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown chaos site {self.site!r}; known: {SITES}"
+            )
+        if self.mode not in MODES:
+            raise ValueError(
+                f"unknown chaos mode {self.mode!r}; known: {MODES}"
+            )
+        if self.count < 1:
+            raise ValueError("chaos spec count must be >= 1")
+        self.remaining = int(self.count)
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "ChaosSpec":
+        known = {f.name for f in fields(cls) if f.init}
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f"Unknown chaos-spec keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        return cls(**config)
+
+
+class ChaosController:
+    """Process-wide injection schedule; thread-safe (the writer thread
+    and the train loop hit sites concurrently)."""
+
+    def __init__(self):
+        self._specs: List[ChaosSpec] = []
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    def configure(
+        self, specs: Sequence[Union[ChaosSpec, Dict[str, Any]]]
+    ) -> None:
+        """Replace the schedule (and reset the event log) with ``specs``
+        plus anything in :data:`ENV_VAR`."""
+        parsed = [
+            s if isinstance(s, ChaosSpec) else ChaosSpec.from_dict(s)
+            for s in specs
+        ]
+        parsed += _env_specs()
+        with self._lock:
+            self._specs = parsed
+            self._events = []
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs = []
+            self._events = []
+
+    def active(self) -> bool:
+        return bool(self._specs)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def check(
+        self,
+        site: str,
+        *,
+        phase: Optional[int] = None,
+        step: Optional[int] = None,
+    ) -> None:
+        """Fire any matching armed spec (raising / signalling /
+        stalling per its mode). The no-chaos fast path is one attribute
+        read + truthiness test."""
+        if not self._specs:
+            return
+        with self._lock:
+            spec = self._match(site, phase, step)
+            if spec is None:
+                return
+            spec.remaining -= 1
+            self._events.append(
+                {
+                    "site": site,
+                    "mode": spec.mode,
+                    "phase": phase,
+                    "step": step,
+                    "remaining": spec.remaining,
+                }
+            )
+        _fire(spec, site)
+
+    def _match(
+        self, site: str, phase: Optional[int], step: Optional[int]
+    ) -> Optional[ChaosSpec]:
+        for spec in self._specs:
+            if spec.site != site or spec.remaining <= 0:
+                continue
+            if spec.phase is not None and spec.phase != phase:
+                continue
+            if spec.step is not None and spec.step != step:
+                continue
+            return spec
+        return None
+
+
+def _env_specs() -> List[ChaosSpec]:
+    raw = os.environ.get(ENV_VAR, "")
+    if not raw:
+        return []
+    try:
+        entries = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"{ENV_VAR} must be a JSON list of chaos-spec dicts: {e}"
+        ) from e
+    return [ChaosSpec.from_dict(d) for d in entries]
+
+
+def _fire(spec: ChaosSpec, site: str) -> None:
+    print(
+        f"chaos: injecting {spec.mode!r} at site {site!r} "
+        f"({spec.remaining} firings left)",
+        file=sys.stderr,
+    )
+    if spec.mode == "error":
+        raise ChaosInjectedIOError(
+            errno.EIO, f"chaos: injected transient I/O error at {site}"
+        )
+    if spec.mode == "disk_full":
+        raise ChaosInjectedIOError(
+            errno.ENOSPC, f"chaos: injected disk-full at {site}"
+        )
+    if spec.mode == "permanent":
+        raise ChaosInjectedStructureError(
+            f"chaos: injected checkpoint structure mismatch at {site} "
+            "(tree structures do not match)"
+        )
+    if spec.mode == "preempt":
+        # a REAL signal, not a flag poke: the handler installed by
+        # resilience/preemption.py (or the default die-now handler when
+        # no guard is installed — also realistic) runs exactly as it
+        # would under a scheduler-issued SIGTERM
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
+    if spec.mode == "stall":
+        time.sleep(max(float(spec.delay_s), 0.0))
+
+
+# ----------------------- module-level singleton ----------------------- #
+
+_controller = ChaosController()
+
+
+def configure(specs: Sequence[Union[ChaosSpec, Dict[str, Any]]]) -> None:
+    _controller.configure(specs)
+
+
+def clear() -> None:
+    _controller.clear()
+
+
+def active() -> bool:
+    return _controller.active()
+
+
+def events() -> List[Dict[str, Any]]:
+    return _controller.events()
+
+
+def check(
+    site: str, *, phase: Optional[int] = None, step: Optional[int] = None
+) -> None:
+    _controller.check(site, phase=phase, step=step)

@@ -1,0 +1,151 @@
+"""Preemption-safe shutdown: SIGTERM/SIGINT → drain at a phase boundary.
+
+Preemptible TPU slices get a termination notice as a signal; the default
+disposition kills the process mid-phase — mid-checkpoint-write in the
+worst case. The guard here converts the signal into a *request* flag;
+trainers poll it at phase boundaries (``BaseRLTrainer.maybe_drain``) and
+drain gracefully: write an emergency atomic checkpoint + a
+flight-recorder dump, then raise :class:`PreemptionDrain` (exit code
+:data:`PREEMPTION_EXIT_CODE` — EX_TEMPFAIL, distinct from a crash so
+schedulers can tell "resumable, was preempted" from "failed"). The
+supervisor (`resilience/supervisor.py`) catches the drain and either
+auto-resumes from the checkpoint it just wrote or re-raises, per
+``train.resilience.resume_on_preemption``.
+
+Signals are only interceptable on the main thread; installing from a
+worker thread degrades to a warning (the run keeps its default
+disposition — better than refusing to start).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import Dict, Optional, Sequence
+
+#: BSD sysexits EX_TEMPFAIL: "try again later" — the semantically
+#: honest code for a preempted-but-resumable run
+PREEMPTION_EXIT_CODE = 75
+
+
+class PreemptionDrain(Exception):
+    """Raised by the trainer's phase-boundary drain after the emergency
+    checkpoint committed. Carries the resume point."""
+
+    exit_code = PREEMPTION_EXIT_CODE
+
+    def __init__(
+        self,
+        message: str,
+        step: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.step = step
+        self.checkpoint_dir = checkpoint_dir
+
+
+class PreemptionGuard:
+    """Installs handlers that record the signal instead of dying."""
+
+    def __init__(self, signals: Sequence[str] = ("SIGTERM", "SIGINT")):
+        self.signal_names = tuple(signals)
+        self._signums = []
+        for name in self.signal_names:
+            num = getattr(signal, name, None)
+            if num is None:
+                raise ValueError(f"unknown signal name {name!r}")
+            self._signums.append(num)
+        self._previous: Dict[int, object] = {}
+        self._received: Optional[int] = None
+        self._installed = False
+
+    # ----------------------------- handlers ---------------------------- #
+
+    def _handler(self, signum, frame) -> None:
+        first = self._received is None
+        self._received = signum
+        if first:
+            print(
+                f"resilience: received {signal.Signals(signum).name} — "
+                "will drain at the next phase boundary (emergency "
+                "checkpoint + flight dump)",
+                file=sys.stderr,
+            )
+
+    def install(self) -> bool:
+        """Install handlers; returns False (with a warning) off the main
+        thread, where CPython forbids signal.signal."""
+        if self._installed:
+            return True
+        if threading.current_thread() is not threading.main_thread():
+            print(
+                "resilience: preemption guard skipped — signals can only "
+                "be intercepted on the main thread",
+                file=sys.stderr,
+            )
+            return False
+        for num in self._signums:
+            self._previous[num] = signal.signal(num, self._handler)
+        self._installed = True
+        return True
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for num, prev in self._previous.items():
+            signal.signal(num, prev)
+        self._previous.clear()
+        self._installed = False
+
+    def requested(self) -> bool:
+        return self._received is not None
+
+    def clear(self) -> None:
+        self._received = None
+
+    @property
+    def received_signal(self) -> Optional[str]:
+        if self._received is None:
+            return None
+        return signal.Signals(self._received).name
+
+
+# ----------------------- module-level singleton ----------------------- #
+
+_guard: Optional[PreemptionGuard] = None
+
+
+def install_guard(
+    signals: Sequence[str] = ("SIGTERM", "SIGINT"),
+) -> PreemptionGuard:
+    """Install (or replace) the process guard; returns it."""
+    global _guard
+    if _guard is not None:
+        _guard.uninstall()
+    _guard = PreemptionGuard(signals)
+    _guard.install()
+    return _guard
+
+
+def uninstall_guard() -> None:
+    global _guard
+    if _guard is not None:
+        _guard.uninstall()
+        _guard = None
+
+
+def drain_requested() -> bool:
+    """True when a guarded signal arrived and the run should drain at
+    the next phase boundary. False (never raises) with no guard."""
+    return _guard is not None and _guard.requested()
+
+
+def clear_request() -> None:
+    if _guard is not None:
+        _guard.clear()
+
+
+def received_signal() -> Optional[str]:
+    return _guard.received_signal if _guard is not None else None

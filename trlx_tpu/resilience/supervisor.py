@@ -1,0 +1,178 @@
+"""Supervised auto-resume: bounded restarts from the last good checkpoint.
+
+`api.train` runs each training attempt under :func:`run_supervised`.
+With ``train.resilience.enabled`` the supervisor installs the
+preemption guard, arms any configured chaos schedule, and classifies
+every escape from the attempt:
+
+- **preemption** (:class:`PreemptionDrain` — the trainer already wrote
+  an emergency checkpoint at the phase boundary): restart resuming from
+  it, unless ``resume_on_preemption`` is off (real preemptions usually
+  want the *next* scheduled job to resume; the in-process restart is
+  what makes kill/resume testable end-to-end);
+- **retriable** (transient host I/O per the `utils/retry.py` taxonomy,
+  or a :class:`HealthAbort` — ``health.on_error: abort`` feeds the
+  supervisor, docs/observability.md): restart from the latest good
+  checkpoint;
+- **permanent** (structure mismatch, config errors, NaN divergence —
+  deterministic failures a restart replays): re-raise immediately.
+
+Restarts are bounded by ``max_restarts``; exhausting the budget raises
+:class:`RestartBudgetExhausted` chaining the last failure. Each attempt
+rebuilds the trainer from scratch (mid-phase state is assumed poisoned)
+and resumes only when a restorable checkpoint actually exists.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import dataclass, field, fields
+from typing import Any, Callable, Dict, List, Optional
+
+from trlx_tpu.resilience import chaos, preemption
+from trlx_tpu.resilience.preemption import PreemptionDrain
+from trlx_tpu.utils.retry import (
+    RetryPolicy,
+    classify_io_error,
+    set_default_policy,
+)
+
+
+class RestartBudgetExhausted(RuntimeError):
+    """The supervisor's restart budget ran out; the last attempt's
+    failure is chained as ``__cause__``."""
+
+
+@dataclass
+class ResilienceConfig:
+    """``train.resilience`` section (plain dict in YAML, parsed here).
+
+    :param enabled: master switch — off (the default) changes nothing:
+        no signal handlers, no retries beyond the module defaults, no
+        supervisor loop.
+    :param max_restarts: restarts (not attempts) the supervisor may
+        spend on retriable failures/preemptions.
+    :param restart_delay_s: base delay before a restart, doubled per
+        consecutive restart (a crash-looping dependency gets backoff,
+        not a tight loop).
+    :param resume_on_preemption: restart in-process after a preemption
+        drain (False re-raises so the scheduler's next job resumes).
+    :param preempt_signals: signal names the guard intercepts.
+    :param retry: `utils/retry.py` RetryPolicy overrides applied to
+        every wrapped I/O path (checkpoint save/load, writer, admission).
+    :param chaos: fault-injection specs (resilience/chaos.py) armed for
+        the supervised run — the config-driven face of ``TRLX_CHAOS``.
+    """
+
+    enabled: bool = False
+    max_restarts: int = 2
+    restart_delay_s: float = 0.0
+    resume_on_preemption: bool = True
+    preempt_signals: List[str] = field(
+        default_factory=lambda: ["SIGTERM", "SIGINT"]
+    )
+    retry: Dict[str, Any] = field(default_factory=dict)
+    chaos: List[Dict[str, Any]] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, config: Optional[Dict[str, Any]]) -> "ResilienceConfig":
+        config = dict(config or {})
+        known = {f.name for f in fields(cls)}
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f"Unknown train.resilience keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        out = cls(**config)
+        if out.max_restarts < 0:
+            raise ValueError("train.resilience.max_restarts must be >= 0")
+        RetryPolicy.from_dict(out.retry)  # validate keys early
+        return out
+
+
+def failure_kind(error: BaseException) -> str:
+    """``preemption`` | ``retriable`` | ``permanent`` for the supervisor.
+
+    HealthAbort is retriable by design: the detector already dumped the
+    forensics file, and the whole point of ``on_error: abort`` under a
+    supervisor is "stop digging, restore the last good checkpoint".
+    NaN-divergence RuntimeErrors and every other deterministic failure
+    stay permanent — replaying them from a checkpoint written *before*
+    the divergence re-fails identically.
+    """
+    from trlx_tpu.telemetry.health import HealthAbort
+
+    if isinstance(error, PreemptionDrain):
+        return "preemption"
+    if isinstance(error, HealthAbort):
+        return "retriable"
+    if not isinstance(error, Exception):
+        return "permanent"  # KeyboardInterrupt / SystemExit: never eat
+    if classify_io_error(error) == "transient":
+        return "retriable"
+    return "permanent"
+
+
+def run_supervised(
+    attempt: Callable[[bool], Any],
+    config,
+    *,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Run ``attempt(resume)`` under the resilience policy of
+    ``config.train.resilience``. Disabled → exactly one attempt with the
+    caller's ``resume_from_checkpoint``, no handlers touched."""
+    rc = ResilienceConfig.from_dict(config.train.resilience)
+    if not rc.enabled:
+        return attempt(bool(config.train.resume_from_checkpoint))
+
+    from trlx_tpu.utils.checkpoint import has_checkpoint
+
+    preemption.install_guard(rc.preempt_signals)
+    if rc.retry:
+        set_default_policy(RetryPolicy.from_dict(rc.retry))
+    # unconditional: configure() also merges TRLX_CHAOS env specs — the
+    # "no code/config changes" injection path must arm even when the
+    # config carries no chaos list of its own
+    chaos.configure(rc.chaos)
+    restarts = 0
+    resume = bool(config.train.resume_from_checkpoint)
+    try:
+        while True:
+            try:
+                return attempt(resume)
+            except BaseException as error:
+                kind = failure_kind(error)
+                if kind == "permanent":
+                    raise
+                if kind == "preemption" and not rc.resume_on_preemption:
+                    raise
+                restarts += 1
+                if restarts > rc.max_restarts:
+                    raise RestartBudgetExhausted(
+                        f"restart budget exhausted "
+                        f"({rc.max_restarts} restarts) — last failure: "
+                        f"{type(error).__name__}: {error}"
+                    ) from error
+                preemption.clear_request()
+                resume = has_checkpoint(config.train.checkpoint_dir)
+                print(
+                    f"resilience: restart {restarts}/{rc.max_restarts} "
+                    f"after {kind} ({type(error).__name__}: {error}) — "
+                    + (
+                        "resuming from "
+                        f"{config.train.checkpoint_dir!r}"
+                        if resume
+                        else "no checkpoint yet, starting fresh"
+                    ),
+                    file=sys.stderr,
+                )
+                if rc.restart_delay_s > 0:
+                    sleep(rc.restart_delay_s * (2 ** (restarts - 1)))
+    finally:
+        preemption.uninstall_guard()
+        chaos.clear()
+        if rc.retry:
+            set_default_policy(None)

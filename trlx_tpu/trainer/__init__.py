@@ -221,6 +221,103 @@ class BaseRLTrainer(ABC):
             last = {**last, **phase_row}
         return last
 
+    def emit_health_event(
+        self,
+        detector: str,
+        severity: str,
+        message: str,
+        series: str = "resilience",
+        value: float = 1.0,
+        step: Optional[int] = None,
+        phase: Optional[int] = None,
+    ) -> None:
+        """Record one host-originated health event (engine fallback and
+        other graceful degradations, docs/resilience.md) through the
+        same sinks a detector trip uses: the monitor's event log, a
+        zero-length marker span, and the Logger's ``health_event`` JSON
+        line. Unlike :meth:`observe_health` this never applies the
+        ``health.on_error`` policy — degradations are the alternative
+        to aborting, not a trigger for it."""
+        from trlx_tpu import telemetry
+        from trlx_tpu.telemetry.health import HealthEvent
+
+        monitor = self.health_monitor
+        ev = HealthEvent(
+            detector=detector,
+            severity=severity,
+            series=series,
+            value=float(value),
+            step=int(step) if step is not None else -1,
+            phase=phase,
+            message=message,
+            fingerprint=monitor.fingerprint if monitor is not None else "",
+        )
+        if monitor is not None:
+            monitor.events.append(ev)
+            monitor.event_counts[detector] = (
+                monitor.event_counts.get(detector, 0) + 1
+            )
+        with telemetry.span(
+            "health/" + detector,
+            severity=severity,
+            series=series,
+            step=ev.step,
+        ):
+            pass
+        logger = getattr(self, "logger", None)
+        if logger is not None:
+            logger.log_health_event(ev.to_dict(), step=step)
+        else:
+            print(
+                f"health: {severity} {detector}: {message}", file=sys.stderr
+            )
+
+    def maybe_drain(
+        self, phase: Optional[int] = None, step: Optional[int] = None
+    ) -> None:
+        """Phase-boundary resilience hook (docs/resilience.md): the
+        ``slow_step`` / ``preempt`` fault-injection sites, then — when a
+        guarded SIGTERM/SIGINT arrived since the last boundary — the
+        graceful drain: write an emergency atomic checkpoint (the same
+        save path as the cadence checkpoint, retried on transient I/O),
+        dump the flight recorder, and raise
+        :class:`~trlx_tpu.resilience.preemption.PreemptionDrain` for
+        the supervisor / a distinct exit code. Costs one flag read per
+        phase when no guard is installed."""
+        from trlx_tpu.resilience import chaos, preemption
+
+        chaos.check("slow_step", phase=phase, step=step)
+        chaos.check("preempt", phase=phase, step=step)
+        if not preemption.drain_requested():
+            return
+        from trlx_tpu.utils.checkpoint import wait_for_checkpoints
+
+        directory = self.config.train.checkpoint_dir
+        print(
+            f"resilience: draining at phase boundary (step {step}) — "
+            f"writing emergency checkpoint to {directory!r}",
+            file=sys.stderr,
+        )
+        self.save()
+        wait_for_checkpoints()  # the drain's whole point is durability
+        recorder = self.flight_recorder
+        if recorder is not None:
+            try:
+                path = recorder.dump("preemption", once=True)
+                if path:
+                    print(
+                        f"health: flight record dumped to {path}",
+                        file=sys.stderr,
+                    )
+            except Exception:
+                pass  # forensics must never block the drain
+        raise preemption.PreemptionDrain(
+            f"preempted ({preemption.received_signal()}): drained at "
+            f"step {step} with an emergency checkpoint in {directory!r}",
+            step=step,
+            checkpoint_dir=directory,
+        )
+
     def record_flight_phase(
         self,
         phase: Optional[int],

@@ -565,11 +565,19 @@ class ILQLTrainer(BaseRLTrainer):
                 k = min(k, to_boundary)
             return max(k, 1)
 
-        for epoch in range(train.epochs):
+        # Resume alignment (docs/resilience.md): a run resumed at step s
+        # continues the SAME epoch/minibatch schedule the uninterrupted
+        # run would — epoch s // n_minibatches, at minibatch
+        # s % n_minibatches of that epoch's seeded order — instead of
+        # retraining the early epochs and never reaching the schedule's
+        # tail before total_steps cuts the run off.
+        epoch0 = iter_count // n_minibatches
+        row0 = iter_count % n_minibatches
+        for epoch in range(epoch0, train.epochs):
             order = self.store.epoch_order(
                 train.batch_size, shuffle=True, seed=train.seed + epoch
             )
-            row = 0
+            row = row0 if epoch == epoch0 else 0
             while row < len(order):
                 k = next_chunk_len(iter_count, len(order) - row)
                 mbs = self.store.stacked_slice(
@@ -622,6 +630,10 @@ class ILQLTrainer(BaseRLTrainer):
                     final_stats.update(eval_stats)
                     self._final_stats = final_stats
                     return final_stats
+                # preemption drain point (docs/resilience.md): the ILQL
+                # "phase boundary" is the fused chunk — emergency
+                # checkpoint + PreemptionDrain before the next dispatch
+                self.maybe_drain(phase=self._chunk_index, step=iter_count)
         self._final_stats = final_stats
         return final_stats
 

@@ -1263,6 +1263,9 @@ class PPOTrainer(BaseRLTrainer):
         self._health_phase += 1
         # the legacy lazy cast copy is dead weight once the snapshot exists
         self._rollout_params_cache = None
+        # recorded so error recovery (the engine-fallback path in the
+        # orchestrator) can re-begin THIS phase with the same plan seed
+        self._last_stream_seed = seed
         # fresh per-row RNG phase: both rollout engines derive row keys
         # from the same single split, so a phase collected continuously
         # is row-comparable to the same phase collected fixed-batch
@@ -1545,8 +1548,26 @@ class PPOTrainer(BaseRLTrainer):
         # the loop's step counter must come from BEFORE any streamed
         # epoch-1 update advances state.step during the initial collection
         start_step = int(self.state.step)
+        # Resume alignment (kill/resume parity, docs/resilience.md): a
+        # run resumed at the end of epoch k must collect its next phase
+        # with the SAME seed the uninterrupted run would (train.seed +
+        # phase index) and run the epoch loop from k, not 0 — otherwise
+        # the resumed run replays phase-0 prompts/shuffles and diverges
+        # from the run it is continuing. The per-pass step count is
+        # derived from the config (the streamed plan uses the same
+        # numbers), so the mapping needs no buffer state. The floor
+        # assumes the checkpoint sits on a pass boundary — true for
+        # preemption-drain and end-of-pass cadence saves; a MID-pass
+        # stepwise-cadence checkpoint resumes at its enclosing pass
+        # boundary's schedule (the partial pass is re-collected fresh —
+        # valid PPO, but bitwise parity is only guaranteed for
+        # boundary checkpoints, docs/resilience.md).
+        pass_steps = method.ppo_epochs * max(
+            method.num_rollouts // train.batch_size, 1
+        )
+        self._epoch0 = start_step // pass_steps if start_step else 0
         if len(self.buffer) == 0 and self.orch is not None:
-            self._collect_phase(start_step, seed=train.seed)
+            self._collect_phase(start_step, seed=train.seed + self._epoch0)
 
         if self._stream is not None:
             # streamed phases advance iter_count by the PLAN's update
@@ -1627,6 +1648,11 @@ class PPOTrainer(BaseRLTrainer):
             self._final_stats = final_stats
             return final_stats, True
         if self.orch is not None and epoch < train.epochs - 1:
+            # preemption drain point (docs/resilience.md): AFTER this
+            # boundary's eval/save (so the saved RNG chain includes any
+            # eval sampling — kill/resume parity), BEFORE the next
+            # phase's collection dispatches
+            self.maybe_drain(phase=self._phase_index, step=iter_count)
             self.buffer.clear_history()
             self._collect_phase(iter_count, seed=train.seed + epoch + 1)
         return final_stats, False
@@ -1643,11 +1669,18 @@ class PPOTrainer(BaseRLTrainer):
 
         # (with a streamed phase active, the sampler serves the frozen
         # behavior snapshot — this eval reflects the pre-phase policy even
-        # though epoch-1 updates may already be in flight)
-        stats = self.evaluate()
-        logger.log(stats, step=0)
-        if hasattr(self, "_last_samples"):
-            logger.log_samples(self._last_samples[1], self._last_samples[0], step=0)
+        # though epoch-1 updates may already be in flight). A mid-run
+        # RESUME skips this step-0 eval: the uninterrupted run did not
+        # evaluate at this point, and the extra eval would advance the
+        # sampler RNG chain — breaking the bitwise kill/resume parity
+        # the preemption drain guarantees (docs/resilience.md).
+        if start_step == 0:
+            stats = self.evaluate()
+            logger.log(stats, step=0)
+            if hasattr(self, "_last_samples"):
+                logger.log_samples(
+                    self._last_samples[1], self._last_samples[0], step=0
+                )
 
         clock = Clock()
         iter_count = start_step  # nonzero after resume
@@ -1661,7 +1694,7 @@ class PPOTrainer(BaseRLTrainer):
             # start (profile_phase traces one whole phase instead)
             jax.profiler.start_trace(train.profile_dir)
             self._profiling = True
-        for epoch in range(train.epochs):
+        for epoch in range(getattr(self, "_epoch0", 0), train.epochs):
             # Streamed phase (the default): collection already interleaved
             # epoch-1 updates against the behavior snapshot; close the
             # phase (residual epochs + stats) and log per-minibatch
@@ -1861,6 +1894,9 @@ class PPOTrainer(BaseRLTrainer):
             # on-policy refresh (post_epoch_callback,
             # `accelerate_ppo_model.py:130-134`)
             if self.orch is not None and epoch < train.epochs - 1:
+                # preemption drain point: same boundary as the
+                # streamed/fused paths' _end_of_pass
+                self.maybe_drain(phase=self._phase_index, step=iter_count)
                 self.buffer.clear_history()
                 self._collect_phase(iter_count, seed=train.seed + epoch + 1)
         self._final_stats = final_stats
@@ -1872,15 +1908,26 @@ class PPOTrainer(BaseRLTrainer):
         directory = directory or self.config.train.checkpoint_dir
         with telemetry.span("phase/checkpoint"):
             # one batched fetch for all host-side save inputs
-            kl_coef, mean_kl, step = jax.device_get(
-                (self.kl_coef, self.mean_kl, self.state.step)
+            kl_coef, mean_kl, step, rng = jax.device_get(
+                (self.kl_coef, self.mean_kl, self.state.step, self.rng)
             )
+            metadata = {
+                "kl_coef": float(kl_coef),
+                "mean_kl": float(mean_kl),
+                # the sampler RNG chain: one split per phase (plus one
+                # per chunk without per-row RNG) — restoring it exactly
+                # is half of kill/resume bitwise parity; the other half
+                # is the orchestrator state below (docs/resilience.md)
+                "rng_key": np.asarray(rng).ravel().tolist(),
+            }
+            orch = getattr(self, "orch", None)
+            if orch is not None and hasattr(orch, "state_dict"):
+                # reward-scaling running moments + prompt-stream position
+                metadata["orchestrator"] = orch.state_dict()
             save_checkpoint(
                 directory,
                 self.state,
-                metadata={
-                    "kl_coef": float(kl_coef), "mean_kl": float(mean_kl),
-                },
+                metadata=metadata,
                 async_save=self.config.train.async_checkpoint,
                 step=int(step),
             )
@@ -1894,3 +1941,14 @@ class PPOTrainer(BaseRLTrainer):
         self.state, meta = load_checkpoint(directory, abstract)
         self.kl_coef = float(meta.get("kl_coef", self.kl_coef))
         self.mean_kl = float(meta.get("mean_kl", self.mean_kl))
+        rng_key = meta.get("rng_key")
+        if rng_key is not None:
+            self.rng = jnp.asarray(
+                np.asarray(rng_key, dtype=np.uint32).reshape(
+                    np.shape(self.rng)
+                )
+            )
+        orch_state = meta.get("orchestrator")
+        orch = getattr(self, "orch", None)
+        if orch_state and orch is not None and hasattr(orch, "load_state_dict"):
+            orch.load_state_dict(orch_state)

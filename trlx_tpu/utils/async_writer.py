@@ -17,27 +17,56 @@ behind a BOUNDED queue:
   of silently dropping rows;
 - the writer is crash-safe: the orchestrator flushes from a ``finally``,
   so rows already queued are drained to disk even when collection raises.
+
+Graceful degradation (docs/resilience.md): write failures are classified
+by the `utils/retry.py` taxonomy. *Permanent* errors (a missing
+directory) surface at the next flush/submit exactly as before.
+*Transient* errors (disk momentarily full, flaky NFS) keep their batches
+in an ordered retry buffer, retried before every later write; after
+``degrade_after`` consecutive transient failures the writer degrades to
+synchronous in-caller writes with a one-time stderr warning — failures
+then surface (or recover) at the write site instead of a phase-end
+flush. If the filesystem recovers, every buffered batch lands in order
+and nothing is raised; rows still unwritable when the run ends surface
+at ``close()`` as a hard error. The write path carries the
+``writer.write`` fault-injection site (resilience/chaos.py) so the
+disk-full scenario is testable deterministically.
 """
 
 from __future__ import annotations
 
 import json
 import queue
+import sys
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from trlx_tpu.resilience import chaos
+from trlx_tpu.utils.retry import classify_io_error
+
+#: buffered-batch cap: past this, unwritable rows become a hard error
+#: (bounded memory beats silently hoarding a run's worth of rollouts)
+_RETRY_CAP = 256
 
 
 class BackgroundJSONLWriter:
     """Append batches of JSON lines to files from a background thread."""
 
-    def __init__(self, maxsize: int = 64):
-        self._q: "queue.Queue[Optional[Tuple[str, List[Dict[str, Any]]]]]" = (
+    def __init__(self, maxsize: int = 64, degrade_after: int = 3):
+        self._q: "queue.Queue[Optional[Tuple[str, List[str]]]]" = (
             queue.Queue(maxsize)
         )
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()
         self._closed = False
+        self.degrade_after = int(degrade_after)
+        self._consecutive_failures = 0
+        self._degraded = False
+        self._warned_degrade = False
+        # ordered (path, lines) batches that failed transiently and are
+        # retried before any later write — rows stay in arrival order
+        self._retry: List[Tuple[str, List[str]]] = []
 
     # ------------------------------ API ------------------------------- #
 
@@ -50,16 +79,32 @@ class BackgroundJSONLWriter:
             raise RuntimeError("writer is closed")
         self._raise_pending()
         lines = [json.dumps(r) for r in rows]
+        if self._degraded:
+            # degraded mode: write in the caller, after the queue's
+            # remaining batches drain (ordering per path is preserved)
+            if self._thread is not None:
+                self._q.join()
+            self._write_buffered(then=(path, lines))
+            return
         self._ensure_thread()
         self._q.put((path, lines))
 
+    @property
+    def degraded(self) -> bool:
+        """True once the writer fell back to synchronous writes."""
+        return self._degraded
+
     def flush(self, reraise: bool = True) -> None:
         """Block until every submitted batch has been written; surface the
-        first background error (``reraise=False`` suppresses it — for
-        ``finally`` blocks where another exception is already in
-        flight)."""
+        first background *permanent* error (``reraise=False`` suppresses
+        it — for ``finally`` blocks where another exception is already
+        in flight). Batches buffered by transient failures get another
+        synchronous attempt here; still-failing ones stay buffered (the
+        degradation contract: a momentarily-full disk must not kill the
+        phase) and become a hard error only at :meth:`close`."""
         if self._thread is not None:
             self._q.join()
+        self._write_buffered()
         if reraise:
             self._raise_pending()
 
@@ -78,8 +123,16 @@ class BackgroundJSONLWriter:
             self._q.put(None)
             self._thread.join(timeout=10)
             self._thread = None
+        self._write_buffered()  # last chance for transient-buffered rows
         if reraise:
             self._raise_pending()
+            if self._retry:
+                n = sum(len(lines) for _, lines in self._retry)
+                raise RuntimeError(
+                    "background rollout writer failed; "
+                    f"{n} row(s) could not be written (transient write "
+                    "failures never recovered)"
+                )
 
     @property
     def pending(self) -> int:
@@ -103,17 +156,73 @@ class BackgroundJSONLWriter:
                 "may be missing"
             ) from err
 
+    def _append(self, path: str, lines: List[str]) -> None:
+        chaos.check("writer.write")
+        with open(path, "a") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def _on_write_failure(
+        self, batch: Tuple[str, List[str]], error: BaseException
+    ) -> None:
+        """Classify one failed batch: transient ⇒ buffer for retry (and
+        maybe degrade), permanent ⇒ pend the error (old behavior)."""
+        if (
+            isinstance(error, Exception)
+            and classify_io_error(error) == "transient"
+            and len(self._retry) < _RETRY_CAP
+        ):
+            self._retry.append(batch)
+            self._consecutive_failures += 1
+            if (
+                self._consecutive_failures >= self.degrade_after
+                and not self._degraded
+            ):
+                self._degraded = True
+                if not self._warned_degrade:
+                    self._warned_degrade = True
+                    print(
+                        "resilience: background rollout writer hit "
+                        f"{self._consecutive_failures} consecutive "
+                        f"transient write failures "
+                        f"({type(error).__name__}: {error}) — degrading "
+                        "to synchronous writes; buffered rows retry "
+                        "before each write",
+                        file=sys.stderr,
+                    )
+            return
+        if self._error is None:
+            self._error = error
+
+    def _write_buffered(
+        self, then: Optional[Tuple[str, List[str]]] = None
+    ) -> None:
+        """Retry buffered batches in order, then (optionally) one new
+        batch; the first failure re-buffers the remainder so ordering
+        survives a still-broken disk."""
+        with self._lock:
+            work = self._retry
+            self._retry = []
+            if then is not None:
+                work.append(then)
+            for i, batch in enumerate(work):
+                try:
+                    self._append(*batch)
+                    self._consecutive_failures = 0
+                except BaseException as e:
+                    self._on_write_failure(batch, e)
+                    # keep the untried tail buffered, in order
+                    self._retry.extend(work[i + 1:])
+                    return
+
     def _run(self) -> None:
         while True:
             item = self._q.get()
             if item is None:
                 self._q.task_done()
                 return
-            path, lines = item
             try:
                 if self._error is None:
-                    with open(path, "a") as f:
-                        f.write("\n".join(lines) + "\n")
+                    self._write_buffered(then=item)
             except BaseException as e:  # surfaced at the next flush/submit
                 if self._error is None:
                     self._error = e

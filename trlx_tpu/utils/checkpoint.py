@@ -14,6 +14,16 @@ composite checkpoint, committed atomically.
 buffers; the write proceeds on Orbax's background thread (SURVEY §5.4
 "Orbax async checkpointing"). :func:`wait_for_checkpoints` joins in-flight
 writes and surfaces background write errors.
+
+Failure taxonomy (docs/resilience.md): save/load failures are classified
+by :func:`classify_checkpoint_error` into *transient* (flaky filesystem
+— retried with bounded backoff via `utils/retry.py`) and *permanent*
+(train-state structure mismatch, wrong path — refused fast with the
+actionable :func:`_structure_mismatch_error` translation). Both paths
+carry the ``checkpoint.save`` / ``checkpoint.load`` fault-injection
+sites (resilience/chaos.py), which is how the ``--chaos-smoke``
+self-check proves a transient error recovers and a permanent one does
+not retry.
 """
 
 from __future__ import annotations
@@ -24,6 +34,9 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 import orbax.checkpoint as ocp
+
+from trlx_tpu.resilience import chaos
+from trlx_tpu.utils.retry import classify_io_error, retry_call
 
 # One manager per directory: managers own background threads, per-directory
 # step bookkeeping, and (multi-host) coordination state. Async is always
@@ -78,17 +91,31 @@ def save_checkpoint(
     stale = sorted(s for s in mgr.all_steps() if s > int(step))
     for s in stale[:-1]:
         mgr.delete(s)
-    try:
-        mgr.save(int(step), args=args, force=True)
-    except ocp.checkpoint_manager.StepAlreadyExistsError:
-        # same-step re-save: replace that step's checkpoint
-        mgr.delete(int(step))
-        mgr.save(int(step), args=args, force=True)
+
+    def _attempt() -> None:
+        chaos.check("checkpoint.save", step=int(step))
+        try:
+            mgr.save(int(step), args=args, force=True)
+        except ocp.checkpoint_manager.StepAlreadyExistsError:
+            # same-step re-save (incl. a retry after a partially-failed
+            # attempt): replace that step's checkpoint
+            mgr.delete(int(step))
+            mgr.save(int(step), args=args, force=True)
+        if stale or not async_save:
+            # join the write when the caller needs durability now (sync
+            # save) or stale-step GC must wait on the commit; a
+            # background failure surfaces here, inside the retry scope
+            mgr.wait_until_finished()
+
+    # transient filesystem errors retry with bounded backoff; anything
+    # else (wrong path, serialization bug) still fails fast
+    retry_call(
+        _attempt,
+        classify=classify_io_error,
+        describe=f"checkpoint save to {directory}",
+    )
     if stale:
-        mgr.wait_until_finished()  # new step committed -> stale can go
-        mgr.delete(stale[-1])
-    if not async_save:
-        mgr.wait_until_finished()
+        mgr.delete(stale[-1])  # new step committed -> stale can go
 
 
 def wait_for_checkpoints() -> None:
@@ -129,6 +156,10 @@ def _structure_mismatch_error(directory: str, e: Exception) -> Optional[ValueErr
     text = f"{type(e).__name__}: {e}".lower()
     if not any(h in text for h in _MISMATCH_HINTS):
         return None
+    if isinstance(e, OSError):
+        # an I/O error whose strerror happens to contain a hint word is
+        # still an I/O error — never translate it into a layout remedy
+        return None
     return ValueError(
         f"checkpoint under {directory} does not match the current "
         "train-state structure. This likely means the optimizer-state "
@@ -140,6 +171,18 @@ def _structure_mismatch_error(directory: str, e: Exception) -> Optional[ValueErr
         "restart the run fresh with a new checkpoint dir. If neither key "
         f"changed, the underlying error was: {type(e).__name__}: {e}"
     )
+
+
+def classify_checkpoint_error(e: Exception) -> str:
+    """Transient-vs-permanent taxonomy for checkpoint I/O failures
+    (docs/resilience.md). A structure mismatch is permanent no matter
+    how orbax typed it — retrying a layout disagreement only delays the
+    actionable error; everything else follows the shared host-I/O
+    taxonomy (OSError family transient, deterministic Python errors
+    permanent)."""
+    if _structure_mismatch_error("", e) is not None:
+        return "permanent"
+    return classify_io_error(e)
 
 
 def load_checkpoint(
@@ -161,8 +204,19 @@ def load_checkpoint(
         # legacy layout only — once managed steps exist they are newer
         # (an upgraded run keeps saving next to the old 'state' dir)
         with ocp.StandardCheckpointer() as ckptr:
+
+            def _restore_legacy():
+                chaos.check("checkpoint.load")
+                return ckptr.restore(legacy_state, abstract_state)
+
             try:
-                state = ckptr.restore(legacy_state, abstract_state)
+                # transient I/O retries with backoff; a structure
+                # mismatch is permanent and refuses on the first attempt
+                state = retry_call(
+                    _restore_legacy,
+                    classify=classify_checkpoint_error,
+                    describe=f"checkpoint restore from {legacy_state}",
+                )
             except Exception as e:  # noqa: BLE001 — orbax raises many types
                 wrapped = _structure_mismatch_error(directory, e)
                 if wrapped is None:
@@ -193,13 +247,24 @@ def load_checkpoint(
                 return 0.0
 
         step = max(steps, key=lambda s: (_saved_at(s), s))
-    try:
-        restored = mgr.restore(
+    def _restore():
+        chaos.check("checkpoint.load")
+        return mgr.restore(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardRestore(abstract_state),
                 host_state=ocp.args.JsonRestore(),
             ),
+        )
+
+    try:
+        # the transient/permanent split (classify_checkpoint_error): a
+        # flaky filesystem read retries with bounded backoff, a
+        # structure mismatch refuses on the first attempt
+        restored = retry_call(
+            _restore,
+            classify=classify_checkpoint_error,
+            describe=f"checkpoint restore from {directory}",
         )
     except Exception as e:  # noqa: BLE001 — orbax raises many types
         wrapped = _structure_mismatch_error(directory, e)

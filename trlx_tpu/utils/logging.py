@@ -35,6 +35,12 @@ class Logger:
         # deltas share the timebase of every span/Clock measurement
         self.start = monotonic()
         self._wandb = None
+        # graceful degradation (docs/resilience.md): consecutive wandb
+        # emission failures past this disable the tracker with one
+        # stderr warning — a crash-looping/unreachable tracker must not
+        # kill (or stall) a training run; stdout JSONL keeps flowing
+        self._wandb_failure_limit = 3
+        self._wandb_failures = 0
         # interactive tqdm progress line (reference shows a tqdm bar with a
         # live loss description, `accelerate_base_model.py:245-297`);
         # stderr-only, so stdout's JSON lines stay machine-parseable
@@ -99,9 +105,42 @@ class Logger:
             # terminal, and printing at the bar's cursor garbles both
             self._pbar.clear()
         print(json.dumps(record, default=float), file=self.stream, flush=True)
-        if self._wandb is not None:
-            self._wandb.log(scalars, step=step)
+        self._wandb_emit(
+            lambda: self._wandb.log(scalars, step=step), what="metrics"
+        )
         self._update_progress(step, scalars)
+
+    def _wandb_emit(self, emit, what: str) -> None:
+        """Run one wandb emission with degradation: an exception never
+        propagates into the train loop (the stdout JSONL line already
+        landed), and repeated consecutive failures disable the tracker
+        with a single warning instead of failing every step. Carries
+        the ``logger.emit`` fault-injection site (resilience/chaos.py)."""
+        if self._wandb is None:
+            return
+        from trlx_tpu.resilience import chaos
+
+        try:
+            chaos.check("logger.emit")
+            emit()
+            self._wandb_failures = 0
+        except Exception as e:
+            self._wandb_failures += 1
+            if self._wandb_failures == 1:
+                print(
+                    f"warning: wandb {what} emission failed "
+                    f"({type(e).__name__}: {e}); will keep trying",
+                    file=sys.stderr,
+                )
+            if self._wandb_failures >= self._wandb_failure_limit:
+                print(
+                    f"warning: wandb emission failed "
+                    f"{self._wandb_failures} times in a row — disabling "
+                    "wandb for this run; metrics continue as stdout JSON "
+                    "lines",
+                    file=sys.stderr,
+                )
+                self._wandb = None
 
     def _update_progress(self, step, scalars) -> None:
         if not (hasattr(sys.stderr, "isatty") and sys.stderr.isatty()):
@@ -141,15 +180,14 @@ class Logger:
             "health_event": event,
         }
         print(json.dumps(record, default=float), file=self.stream, flush=True)
-        if self._wandb is not None:
-            try:
-                detector = event.get("detector", "unknown")
-                self._wandb.log(
-                    {f"health/event/{detector}": float(event.get("value", 1.0))},
-                    step=step,
-                )
-            except Exception:
-                pass
+        detector = event.get("detector", "unknown")
+        self._wandb_emit(
+            lambda: self._wandb.log(
+                {f"health/event/{detector}": float(event.get("value", 1.0))},
+                step=step,
+            ),
+            what="health event",
+        )
 
     def log_samples(self, rows, columns, step: Optional[int] = None) -> None:
         """Log generated-sample tables (reference wandb Table,
@@ -162,15 +200,15 @@ class Logger:
             printable = {c: str(v)[:120] for c, v in zip(columns, row)}
             print(json.dumps({"sample": printable}, default=str), file=self.stream)
         if self._wandb is not None:
-            try:
-                import wandb
+            import wandb
 
-                self._wandb.log(
+            self._wandb_emit(
+                lambda: self._wandb.log(
                     {"samples": wandb.Table(columns=list(columns), rows=[list(r) for r in rows])},
                     step=step,
-                )
-            except Exception:
-                pass
+                ),
+                what="sample table",
+            )
 
     def finish(self) -> None:
         if self._pbar is not None:

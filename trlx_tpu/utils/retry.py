@@ -1,0 +1,177 @@
+"""Bounded-exponential-backoff retry for host-side I/O paths.
+
+The genuinely retriable failures in a long TPU run are host-side and
+environmental — a flaky network filesystem under the checkpoint
+directory, a wandb endpoint timing out, a momentarily-full disk under
+the rollout log. Those must not kill a multi-day job. Everything else
+(a checkpoint whose train-state structure changed, a config typo, a
+programming error) must keep failing *fast*: retrying a structure
+mismatch three times with backoff just delays the actionable error.
+
+:func:`retry_call` encodes that split: a ``classify`` function maps each
+exception to ``"transient"`` (retry with backoff, bounded by attempts
+and an optional wall-clock budget) or ``"permanent"`` (re-raise
+immediately). :func:`classify_io_error` is the default taxonomy, shared
+by checkpoint save/load (`utils/checkpoint.py`), the background rollout
+writer, server admission, and the fault-injection harness's self-checks
+(docs/resilience.md "Failure taxonomy").
+
+Every retry is appended to a bounded module-level :data:`retry_log` so
+tests and the ``--chaos-smoke`` self-check can assert "this scenario
+recovered via N retries" without scraping stderr.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import dataclass, fields
+from typing import Any, Callable, Dict, List, Optional
+
+from trlx_tpu.telemetry.tracer import monotonic
+
+#: errors that are permanent no matter what: the path itself is wrong,
+#: not the filesystem's mood — a retry re-fails identically
+PERMANENT_IO_ERRORS = (
+    FileNotFoundError,
+    NotADirectoryError,
+    IsADirectoryError,
+    PermissionError,
+)
+
+#: unambiguously-transient OS/network failures
+TRANSIENT_IO_ERRORS = (
+    TimeoutError,
+    ConnectionError,
+    BrokenPipeError,
+    InterruptedError,
+)
+
+
+def classify_io_error(error: BaseException) -> str:
+    """Default transient-vs-permanent taxonomy for host I/O failures.
+
+    Any remaining :class:`OSError` (EIO, ENOSPC, ESTALE, the generic
+    orbax/gcsfs wrapping of a flaky filesystem) counts as transient: the
+    environment may recover. Any non-OS exception (ValueError structure
+    mismatch, TypeError, KeyError) is permanent: retrying deterministic
+    Python errors only delays them.
+    """
+    if isinstance(error, PERMANENT_IO_ERRORS):
+        return "permanent"
+    if isinstance(error, TRANSIENT_IO_ERRORS):
+        return "transient"
+    if isinstance(error, OSError):
+        return "transient"
+    return "permanent"
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded exponential backoff: ``base * multiplier^k``, capped at
+    ``max_delay_s`` per wait and ``timeout_s`` total (None = unbounded
+    by wall-clock; attempts still bound it)."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 2.0
+    timeout_s: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, config: Optional[Dict[str, Any]]) -> "RetryPolicy":
+        config = dict(config or {})
+        known = {f.name for f in fields(cls)}
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f"Unknown retry-policy keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        out = cls(**config)
+        if out.max_attempts < 1:
+            raise ValueError("retry max_attempts must be >= 1")
+        return out
+
+
+# Module default, overridable by the resilience supervisor
+# (`train.resilience.retry`) so one config section tunes every wrapped
+# I/O path at once.
+_default_policy = RetryPolicy()
+
+
+def default_policy() -> RetryPolicy:
+    return _default_policy
+
+
+def set_default_policy(policy: Optional[RetryPolicy]) -> None:
+    global _default_policy
+    _default_policy = policy or RetryPolicy()
+
+
+#: bounded record of retries this process performed (newest last);
+#: entries: {"what", "attempt", "delay_s", "error"} — assertable by
+#: tests and the chaos smoke
+retry_log: List[Dict[str, Any]] = []
+_RETRY_LOG_CAP = 256
+
+
+def reset_retry_log() -> None:
+    retry_log.clear()
+
+
+def _note_retry(what: str, attempt: int, delay: float,
+                error: BaseException) -> None:
+    retry_log.append(
+        {
+            "what": what,
+            "attempt": attempt,
+            "delay_s": round(delay, 4),
+            "error": f"{type(error).__name__}: {error}",
+        }
+    )
+    if len(retry_log) > _RETRY_LOG_CAP:
+        del retry_log[: len(retry_log) - _RETRY_LOG_CAP]
+    print(
+        f"retry: {what} failed transiently "
+        f"({type(error).__name__}: {error}); attempt {attempt} — "
+        f"backing off {delay:.2f}s",
+        file=sys.stderr,
+    )
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    *,
+    policy: Optional[RetryPolicy] = None,
+    classify: Callable[[BaseException], str] = classify_io_error,
+    describe: str = "operation",
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Call ``fn()``; retry transient failures with bounded backoff.
+
+    ``classify(error) -> "transient" | "permanent"`` decides; permanent
+    errors and transient errors past the attempt/timeout budget re-raise
+    unchanged (callers keep their existing error-translation logic).
+    """
+    policy = policy or default_policy()
+    delay = policy.base_delay_s
+    started = monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except Exception as error:
+            if classify(error) != "transient":
+                raise
+            if attempt >= policy.max_attempts:
+                raise
+            if (
+                policy.timeout_s is not None
+                and (monotonic() - started) + delay > policy.timeout_s
+            ):
+                raise
+            _note_retry(describe, attempt, delay, error)
+            sleep(delay)
+            delay = min(delay * policy.multiplier, policy.max_delay_s)
