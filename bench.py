@@ -288,6 +288,26 @@ def _workload_config(num_layers_unfrozen, ref_branch_layers):
     # engine (the payload then carries collect/admit_ms + slot_util next
     # to the phase tree)
     rollout_engine = os.environ.get("TRLX_BENCH_ROLLOUT_ENGINE", "fixed")
+    # asynchronous actor–learner mode (docs/async_pipeline.md): set
+    # TRLX_BENCH_ASYNC_RL=1 to run the phases on the async schedule
+    # (forces the continuous engine; TRLX_BENCH_ASYNC_STALENESS tunes
+    # the window, default 1). The default fixed-path r01–r05 series
+    # stays comparable — async is opt-in per round, and the payload
+    # then carries async/staleness_p50, async/learner_idle_ms and the
+    # actor/learner occupancy next to the span tree.
+    async_rl_on = os.environ.get("TRLX_BENCH_ASYNC_RL") == "1"
+    async_rl = (
+        {
+            "enabled": True,
+            "staleness_window": int(
+                os.environ.get("TRLX_BENCH_ASYNC_STALENESS", "1")
+            ),
+        }
+        if async_rl_on
+        else {}
+    )
+    if async_rl_on:
+        rollout_engine = "continuous"
 
     return TRLConfig.from_dict(
         {
@@ -335,6 +355,7 @@ def _workload_config(num_layers_unfrozen, ref_branch_layers):
                 # lockfile is unaffected)
                 "health": {"enabled": True},
                 "rollout": {"engine": rollout_engine},
+                "async_rl": async_rl,
             },
             "method": {
                 "name": "PPOConfig",
@@ -529,6 +550,20 @@ def measure_throughput(config, n_phases=5):
         out["exp/overlap_saved_ms"] = round(
             overlap_saved["ms"] / overlap_saved["phases"], 1
         )
+    # async actor–learner attribution (TRLX_BENCH_ASYNC_RL=1,
+    # docs/async_pipeline.md): staleness distribution, learner idle,
+    # and actor/learner occupancy of the last measured phase ride the
+    # payload next to the span tree (ground truth for the wall-clock
+    # delta is ab_async_rl.py, which self-records)
+    for key in (
+        "async/staleness_p50", "async/staleness_max",
+        "async/consumed_lag_p50", "async/consumed_lag_max",
+        "async/learner_idle_ms", "async/guard_hold_ms",
+        "async/actor_occupancy", "async/learner_occupancy",
+        "async/weight_pushes",
+    ):
+        if key in trainer._last_overlap_stats:
+            out[key] = round(float(trainer._last_overlap_stats[key]), 4)
     if peak:
         out["mfu"] = round(achieved_tflops / peak, 4)
         out["bf16_peak_tflops"] = peak

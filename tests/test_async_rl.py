@@ -1,0 +1,577 @@
+"""Asynchronous actor–learner PPO (trainer/async_rl.py,
+docs/async_pipeline.md).
+
+The contract under test, tier-1:
+
+- **degenerate-mode parity canary**: a full async phase at
+  ``staleness_window=0`` (continuous engine, health on) is BITWISE
+  identical — final params, KL sequence, every per-update stat — to
+  the serial same-plan streamed phase from the same initial state,
+  with zero weight pushes and zero health events (the PR-3/8/9 parity
+  pattern). The nightly tier re-pins it on the mixed fsdp×tp mesh.
+- **engine weight push**: a push landing between a harvest and its
+  refill must not drop the queued admit group (the admission
+  starvation edge); rows admitted after a push carry the new behavior
+  version; the ``engine.admit`` chaos site under async mode surfaces
+  as an ``actor-dead`` health event + ActorDeadError (supervisor
+  recovery is exercised end-to-end by ``--async-smoke``).
+- **amortized done polling**: ``poll_interval`` k=1 (the default every
+  tier-1 parity test above runs at) reproduces the poll-every-step
+  loop; k>1 pays k× fewer host fetches with per-row bitwise-identical
+  tokens (group composition may differ — per-row content never does).
+
+Nightly (slow): staleness>0 learning-curve sanity on dp and the mixed
+fsdp×tp mesh — the genuinely off-policy schedule must keep training
+healthy (finite stats, staleness within the window, pushes actually
+in flight).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("WANDB_DISABLED", "1")
+
+import jax
+
+from trlx_tpu.analysis import harness
+from trlx_tpu.data.configs import TRLConfig
+
+DP_MESH = {"dp": -1, "fsdp": 1, "tp": 1}
+MIX_MESH = {"dp": 2, "fsdp": 2, "tp": 2}
+
+
+def _config(mesh, async_rl=None, rollout_extra=None):
+    cfg = harness.tiny_config_dict("ppo", mesh=dict(mesh))
+    cfg["method"].update(num_rollouts=16, chunk_size=8, ppo_epochs=2)
+    cfg["train"]["batch_size"] = 8
+    cfg["train"]["rollout"] = {
+        "engine": "continuous", "slots": 8, "admit_width": 8,
+        "harvest_width": 8, **(rollout_extra or {}),
+    }
+    cfg["train"]["health"] = {"enabled": True}
+    cfg["method"]["gen_kwargs"]["min_new_tokens"] = 1
+    if async_rl:
+        cfg["train"]["async_rl"] = dict(async_rl)
+    return TRLConfig.from_dict(cfg)
+
+
+def _reward(samples, queries, response_gt=None):
+    return [float(len(s)) for s in samples]
+
+
+_CACHE = {}
+
+
+def _cached_trainer(name, mesh, async_rl=None, rollout_extra=None):
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    if name not in _CACHE:
+        _CACHE[name] = PPOTrainer(
+            _config(mesh, async_rl, rollout_extra), reward_fn=_reward
+        )
+    return _CACHE[name]
+
+
+def _run_phase(trainer, init_state, overlap=None, seed=11):
+    """One full phase from a pinned initial state (the
+    test_phase_overlap reset discipline: host state a phase mutates is
+    reset so both arms consume bitwise-identical inputs)."""
+    from trlx_tpu.orchestrator.ppo_orchestrator import PPOOrchestrator
+    from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+
+    trainer.state = jax.device_put(init_state, trainer.state_shardings)
+    trainer.rng = jax.random.PRNGKey(123)
+    trainer.kl_coef = float(trainer.config.method.init_kl_coef)
+    trainer.mean_kl = 0.0
+    trainer.buffer.clear_history()
+    rng = np.random.default_rng(3)
+    prompts = [
+        [int(x) for x in rng.integers(1, 30, size=4)] for _ in range(64)
+    ]
+    pipe = PromptPipeline(prompts, trainer.config.train.seq_length)
+    orch = PPOOrchestrator(trainer, pipe, reward_fn=_reward, chunk_size=8)
+    trainer.begin_streamed_phase(seed=seed, overlap=overlap)
+    orch.make_experience(trainer.config.method.num_rollouts, 0)
+    n_up, rows, kl_seq = trainer.finish_streamed_phase()
+    orch.close()
+    params = jax.device_get(trainer.state.params)
+    return params, rows, kl_seq, n_up
+
+
+# ------------------------------ config ---------------------------------- #
+
+
+def test_async_config_validation():
+    from trlx_tpu.trainer.async_rl import AsyncRLConfig
+
+    cfg = AsyncRLConfig.from_dict(
+        {"enabled": True, "staleness_window": 2, "actor_fraction": 0.5}
+    )
+    assert cfg.enabled and cfg.staleness_window == 2
+    assert AsyncRLConfig.from_dict(None) == AsyncRLConfig()
+    with pytest.raises(ValueError, match="Unknown train.async_rl"):
+        AsyncRLConfig.from_dict({"staleness": 1})
+    with pytest.raises(ValueError, match="staleness_window"):
+        AsyncRLConfig.from_dict({"staleness_window": -1})
+    with pytest.raises(ValueError, match="actor_fraction"):
+        AsyncRLConfig.from_dict({"actor_fraction": 0.0})
+    with pytest.raises(ValueError, match="actor_fraction"):
+        AsyncRLConfig.from_dict({"actor_fraction": 1.5})
+    with pytest.raises(ValueError, match="poll_interval"):
+        from trlx_tpu.inference import RolloutEngineConfig
+
+        RolloutEngineConfig.from_dict({"poll_interval": 0})
+
+
+def test_async_requires_continuous_engine():
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    cfg = harness.tiny_config_dict("ppo", mesh=dict(DP_MESH))
+    cfg["train"]["async_rl"] = {"enabled": True}
+    with pytest.raises(ValueError, match="continuous"):
+        PPOTrainer(TRLConfig.from_dict(cfg), reward_fn=_reward)
+
+
+def test_async_refuses_phase_overlap_off():
+    # with overlap globally off the landing hook never fires — the run
+    # would be silently serial while the user believes async is on
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    cfg = harness.tiny_config_dict("ppo", mesh=dict(DP_MESH))
+    cfg["train"]["rollout"] = {"engine": "continuous"}
+    cfg["train"]["async_rl"] = {"enabled": True}
+    cfg["train"]["phase_overlap"] = False
+    with pytest.raises(ValueError, match="phase_overlap"):
+        PPOTrainer(TRLConfig.from_dict(cfg), reward_fn=_reward)
+
+
+def test_async_refuses_ilql():
+    from trlx_tpu.trainer.ilql_trainer import ILQLTrainer
+
+    cfg = harness.tiny_config_dict("ilql")
+    cfg["train"]["async_rl"] = {"enabled": True}
+    with pytest.raises(NotImplementedError, match="async_rl"):
+        ILQLTrainer(TRLConfig.from_dict(cfg))
+
+
+def test_version_lag_guard_unit():
+    from trlx_tpu.trainer.async_rl import guard_allows
+
+    # nothing in flight: always allowed (landed rows train regardless)
+    assert guard_allows(5, None, 0)
+    # W=0: any in-flight work defers any update
+    assert not guard_allows(0, 0, 0)
+    # W=1: the first update over version-0 in-flight work is allowed,
+    # the second is not until the actors catch up
+    assert guard_allows(0, 0, 1)
+    assert not guard_allows(1, 0, 1)
+    assert guard_allows(1, 1, 1)
+
+
+def test_buffer_version_tags():
+    from trlx_tpu.data.ppo_types import PPORolloutBatch
+    from trlx_tpu.pipeline.ppo_buffer import PPORolloutBuffer
+
+    import jax.numpy as jnp
+
+    def chunk(rows, base=0):
+        ids = np.arange(base, base + rows, dtype=np.int32)
+        return PPORolloutBatch(
+            query_tokens=jnp.asarray(np.tile(ids[:, None], (1, 2))),
+            query_mask=jnp.ones((rows, 2), jnp.int32),
+            response_tokens=jnp.zeros((rows, 3), jnp.int32),
+            response_mask=jnp.ones((rows, 3), jnp.int32),
+            logprobs=jnp.zeros((rows, 3), jnp.float32),
+            values=jnp.zeros((rows, 3), jnp.float32),
+            rewards=jnp.zeros((rows, 3), jnp.float32),
+        )
+
+    buf = PPORolloutBuffer()
+    buf.begin_stream(8)
+    buf.push(chunk(4, 0))  # untagged -> version 0
+    buf.push(chunk(4, 4), versions=[1, 1, 2, 2])
+    np.testing.assert_array_equal(
+        buf.row_versions(np.arange(8)), [0, 0, 0, 0, 1, 1, 2, 2]
+    )
+    # plan-shaped (stacked) indexing works too
+    np.testing.assert_array_equal(
+        buf.row_versions(np.asarray([[0, 5], [7, 1]])), [[0, 1], [2, 0]]
+    )
+    with pytest.raises(ValueError, match="landed"):
+        buf.row_versions(np.asarray([9]))
+    with pytest.raises(ValueError, match="versions"):
+        buf.push(chunk(4), versions=[1, 2])
+    # landed data is row-correct after the version-tagged landings
+    np.testing.assert_array_equal(
+        np.asarray(buf.full.query_tokens)[:, 0], np.arange(8)
+    )
+    buf.clear_history()
+    # chunk mode tags too
+    buf.push(chunk(3), versions=[4, 5, 6])
+    np.testing.assert_array_equal(buf.row_versions(np.asarray([2, 0])), [6, 4])
+
+
+# --------------------------- engine push -------------------------------- #
+
+
+def test_engine_push_between_harvest_and_refill():
+    """The admission starvation edge (ISSUE 11 satellite): a weight
+    refresh landing between a harvest and its refill must not drop the
+    queued admit group — every submitted row is harvested exactly once,
+    and rows admitted after the push carry the new version."""
+    trainer = _cached_trainer("plain_dp", DP_MESH, rollout_extra={
+        "slots": 8, "admit_width": 4, "harvest_width": 4,
+    })
+    engine = trainer.rollout_engine_obj
+    trainer.rng = jax.random.PRNGKey(7)
+    trainer.reset_rollout_phase()
+    rng = np.random.default_rng(5)
+    N = 24  # 24 rows through 8 slots: the queue backs up past the pool
+    ids = rng.integers(1, 30, (N, trainer.query_length)).astype(np.int32)
+    mask = np.ones_like(ids)
+    engine.start_phase(trainer.rollout_params(), trainer.rollout_phase_key())
+    engine.submit(ids, mask)
+    assert engine.min_inflight_version() == 0
+
+    pushed = [False]
+    seen = {}
+    for group in engine.drive(N):
+        # the push lands here — between this group's harvest/refill and
+        # the next admission, exactly the window the safe-point rule
+        # protects (a naive in-place swap that reset host bookkeeping
+        # would drop the queued rows and starve the drain)
+        if not pushed[0]:
+            engine.push_weights(trainer.rollout_params(), version=1)
+            pushed[0] = True
+            # staged, not applied: the swap waits for the safe point
+            assert engine.param_version == 0
+        for j, r in enumerate(group["rows"]):
+            assert r not in seen, "row harvested twice"
+            seen[r] = group["versions"][j]
+    assert set(seen) == set(range(N))
+    assert engine.pending == 0
+    assert engine.stats.completed == N
+    assert engine.stats.weight_pushes == 1
+    assert engine.param_version == 1
+    # both behavior versions are represented: rows in flight at the
+    # push kept version 0, rows admitted after it carry version 1
+    assert set(seen.values()) == {0, 1}
+    # version tags are admission-monotone in draw order
+    versions = [seen[r] for r in range(N)]
+    assert versions == sorted(versions)
+
+
+def test_chaos_admit_under_async_surfaces_actor_dead():
+    """Regression (chaos site ``engine.admit``): under async mode an
+    injected admission failure must surface as an ``actor-dead`` health
+    event + ActorDeadError — never a silent fixed-sampler fallback —
+    and the trainer must be re-enterable for the supervisor's next
+    attempt (the clean re-run completes)."""
+    from trlx_tpu.resilience import chaos
+    from trlx_tpu.trainer.async_rl import ActorDeadError
+
+    trainer = _cached_trainer(
+        "async1_dp", DP_MESH, async_rl={"enabled": True, "staleness_window": 1}
+    )
+    init = jax.device_get(trainer.state)
+    chaos.configure([{"site": "engine.admit", "mode": "error", "count": 1}])
+    try:
+        with pytest.raises(ActorDeadError):
+            _run_phase(trainer, init)
+        trainer.abort_streamed_phase()
+    finally:
+        chaos.clear()
+    assert trainer.rollout_engine == "continuous"  # not degraded
+    assert trainer.health_monitor.event_counts.get("actor-dead") == 1
+    # re-enterable: the clean retry runs the full phase
+    params, rows, kl_seq, n_up = _run_phase(trainer, init)
+    assert n_up == 4
+    assert all(np.isfinite(v).all() for v in rows.values())
+
+
+# ------------------------- poll amortization ---------------------------- #
+
+
+def test_poll_interval_amortized_row_parity():
+    """k=1 (the default every parity test in this file runs at) polls
+    every step; k=3 pays ~3× fewer host fetches and must yield per-row
+    bitwise-identical tokens/mask/logprobs/values — only harvest-group
+    composition may differ."""
+    trainer = _cached_trainer("plain_dp", DP_MESH, rollout_extra={
+        "slots": 8, "admit_width": 4, "harvest_width": 4,
+    })
+    import dataclasses
+
+    base = trainer.rollout_engine_obj
+
+    def run(k):
+        engine = type(base)(
+            apply_fn=base._apply_fn,
+            init_cache_fn=base._init_cache_fn,
+            gen_config=dataclasses.replace(trainer.gen_config),
+            query_length=trainer.query_length,
+            vocab_size=trainer.model_config.vocab_size,
+            num_slots=8,
+            admit_width=4,
+            harvest_width=4,
+            block_size=4,
+            done_poll_interval=k,
+            mesh=trainer.mesh,
+            param_shardings=trainer.param_shardings,
+            with_values=True,
+        )
+        trainer.rng = jax.random.PRNGKey(55)
+        trainer.reset_rollout_phase()
+        ids = np.random.default_rng(9).integers(
+            1, 30, (16, trainer.query_length)
+        ).astype(np.int32)
+        engine.start_phase(
+            trainer.rollout_params(), trainer.rollout_phase_key()
+        )
+        engine.submit(ids, np.ones_like(ids))
+        got = {}
+        for g in engine.drive(16):
+            arrs = {key: np.asarray(g[key]) for key in
+                    ("tokens", "response_mask", "logprobs", "values")}
+            for j, r in enumerate(g["rows"]):
+                got[r] = {key: v[j] for key, v in arrs.items()}
+        return got, engine.stats
+
+    g1, s1 = run(1)
+    g3, s3 = run(3)
+    assert s1.done_polls == s1.decode_steps  # k=1 IS poll-every-step
+    assert s3.done_polls <= (s3.decode_steps + 2) // 3
+    assert set(g1) == set(g3) == set(range(16))
+    for r in range(16):
+        for key in ("tokens", "response_mask", "logprobs", "values"):
+            np.testing.assert_array_equal(
+                g1[r][key], g3[r][key], err_msg=f"row {r} {key} k=3"
+            )
+
+
+def test_learner_side_error_not_wrapped_as_actor_dead():
+    """Taxonomy regression: a deterministic failure on the LEARNER side
+    of the collect loop (the user reward fn) must propagate as itself —
+    wrapping it in retriable ActorDeadError would burn the supervisor's
+    restart budget replaying it (failure_kind promises fail-fast on
+    deterministic errors)."""
+    from trlx_tpu.orchestrator.ppo_orchestrator import PPOOrchestrator
+    from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+
+    trainer = _cached_trainer(
+        "async1_dp", DP_MESH, async_rl={"enabled": True, "staleness_window": 1}
+    )
+    trainer.rng = jax.random.PRNGKey(3)
+    trainer.buffer.clear_history()
+    prompts = [[1, 2, 3, 4] for _ in range(32)]
+    pipe = PromptPipeline(prompts, trainer.config.train.seq_length)
+
+    def bad_reward(samples, queries, response_gt=None):
+        raise TypeError("deterministic reward bug")
+
+    orch = PPOOrchestrator(trainer, pipe, reward_fn=bad_reward, chunk_size=8)
+    trainer.begin_streamed_phase(seed=5)
+    try:
+        with pytest.raises(TypeError, match="deterministic reward bug"):
+            orch.make_experience(trainer.config.method.num_rollouts, 0)
+    finally:
+        trainer.abort_streamed_phase()
+        orch.close()
+    assert trainer.rollout_engine == "continuous"
+
+
+def test_forced_drain_with_inflight_leftovers_stays_serial():
+    """Over-submission regression: when the draw chunk (8) does not
+    divide the harvest-rounded target (20), drive() returns with rows
+    still in flight. The forced drain in finish_streamed_phase must not
+    count them against the staleness invariant (they can never land in
+    this plan) nor stage weight pushes in the W=0 degenerate mode — and
+    the phase must stay bitwise-serial."""
+    tr_async = _cached_trainer(
+        "async0_dp", DP_MESH, async_rl={"enabled": True, "staleness_window": 0}
+    )
+    tr_serial = _cached_trainer("plain_dp", DP_MESH, rollout_extra={
+        "slots": 8, "admit_width": 4, "harvest_width": 4,
+    })
+    init = jax.device_get(tr_async.state)
+
+    def run(trainer, overlap):
+        from trlx_tpu.orchestrator.ppo_orchestrator import PPOOrchestrator
+        from trlx_tpu.pipeline.prompt_pipeline import PromptPipeline
+
+        trainer.state = jax.device_put(init, trainer.state_shardings)
+        trainer.rng = jax.random.PRNGKey(123)
+        trainer.kl_coef = float(trainer.config.method.init_kl_coef)
+        trainer.mean_kl = 0.0
+        trainer.buffer.clear_history()
+        rng = np.random.default_rng(3)
+        prompts = [
+            [int(x) for x in rng.integers(1, 30, size=4)] for _ in range(64)
+        ]
+        pipe = PromptPipeline(prompts, trainer.config.train.seq_length)
+        orch = PPOOrchestrator(
+            trainer, pipe, reward_fn=_reward, chunk_size=8
+        )
+        # 20 rollouts: harvest width 4 keeps target 20; the 8-wide draw
+        # submits 24 — 4 rows are still in flight when drive() returns
+        trainer.begin_streamed_phase(
+            seed=11, num_rollouts=20, overlap=overlap
+        )
+        orch.make_experience(20, 0)
+        n_up, rows, kl_seq = trainer.finish_streamed_phase()
+        orch.close()
+        return jax.device_get(trainer.state.params), rows, kl_seq
+
+    p_a, r_a, kl_a = run(tr_async, None)
+    st = tr_async._last_overlap_stats
+    assert st["async/weight_pushes"] == 0.0
+    assert st["async/staleness_max"] == 0.0
+    assert not [
+        e for e in tr_async.health_monitor.events
+        if e.detector == "staleness-breach"
+    ]
+    p_s, r_s, kl_s = run(tr_serial, False)
+    assert kl_a == kl_s
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_a),
+        jax.tree_util.tree_leaves(p_s),
+        strict=True,
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for key in r_a:
+        np.testing.assert_array_equal(r_a[key], r_s[key], err_msg=key)
+
+
+# ------------------- staleness_window=0 bitwise parity ------------------- #
+
+
+def test_async_staleness0_bitwise_parity_canary():
+    """Tier-1 acceptance pin (the PR-3/8/9 parity pattern): the async
+    schedule at staleness_window=0 executes the serial same-plan phase
+    bitwise — params, KL sequence, per-update stats — with zero weight
+    pushes and zero health events. The mixed-mesh version is nightly
+    (test_async_staleness0_parity_fsdp_tp)."""
+    tr_async = _cached_trainer(
+        "async0_dp", DP_MESH, async_rl={"enabled": True, "staleness_window": 0}
+    )
+    tr_serial = _cached_trainer("plain_dp", DP_MESH, rollout_extra={
+        "slots": 8, "admit_width": 4, "harvest_width": 4,
+    })
+    init = jax.device_get(tr_async.state)
+
+    p_a, r_a, kl_a, n_a = _run_phase(tr_async, init)
+    st = tr_async._last_overlap_stats
+    assert st["async/weight_pushes"] == 0.0
+    assert st["async/staleness_max"] == 0.0
+    assert tr_async.health_monitor.events == []
+
+    p_s, r_s, kl_s, n_s = _run_phase(tr_serial, init, overlap=False)
+    assert n_a == n_s == 4  # 2 minibatches x 2 ppo epochs
+    assert kl_a == kl_s
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_a),
+        jax.tree_util.tree_leaves(p_s),
+        strict=True,
+    ):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.isfinite(a).all()
+        np.testing.assert_array_equal(a, b)
+    assert set(r_a) == set(r_s)
+    for key in r_a:
+        np.testing.assert_array_equal(r_a[key], r_s[key], err_msg=key)
+
+
+@pytest.mark.slow
+def test_async_staleness0_parity_fsdp_tp():
+    """Nightly: the degenerate-mode bitwise contract holds on the mixed
+    fsdp×tp mesh (the mesh family that historically NaN'd via the
+    sharded-concat lowering — the version-tagged landing must not
+    reintroduce it)."""
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    tr_async = PPOTrainer(
+        _config(MIX_MESH, async_rl={"enabled": True, "staleness_window": 0}),
+        reward_fn=_reward,
+    )
+    tr_serial = PPOTrainer(_config(MIX_MESH), reward_fn=_reward)
+    init = jax.device_get(tr_async.state)
+    p_a, r_a, kl_a, n_a = _run_phase(tr_async, init)
+    p_s, r_s, kl_s, n_s = _run_phase(tr_serial, init, overlap=False)
+    assert n_a == n_s and kl_a == kl_s
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_a),
+        jax.tree_util.tree_leaves(p_s),
+        strict=True,
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for key in r_a:
+        np.testing.assert_array_equal(r_a[key], r_s[key], err_msg=key)
+
+
+# -------------------- staleness>0 learning sanity ------------------------ #
+
+
+def _learning_sanity(mesh, name):
+    trainer = _cached_trainer(
+        name, mesh, async_rl={"enabled": True, "staleness_window": 1}
+    )
+    init = jax.device_get(trainer.state)
+    params, rows, kl_seq, n_up = _run_phase(trainer, init)
+    st = trainer._last_overlap_stats
+    # the genuinely-async schedule ran: weights were pushed in flight,
+    # staleness stayed within the window, and nothing tripped
+    assert st["async/weight_pushes"] >= 1
+    assert 0 < st["async/staleness_max"] <= 1
+    assert not [
+        e for e in trainer.health_monitor.events
+        if e.detector == "staleness-breach"
+    ]
+    assert n_up == 4
+    for key, v in rows.items():
+        assert np.isfinite(v).all(), key
+    # a second phase continues from the updated policy without drama
+    # (the learning-curve half: losses stay finite, params keep moving)
+    before = jax.tree_util.tree_leaves(jax.device_get(trainer.state.params))
+    _run_phase(trainer, jax.device_get(trainer.state), seed=13)
+    after = jax.tree_util.tree_leaves(jax.device_get(trainer.state.params))
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(before, after)
+    )
+
+
+@pytest.mark.slow
+def test_async_staleness1_learning_sanity_dp():
+    _learning_sanity(DP_MESH, "async1_dp")
+
+
+@pytest.mark.slow
+def test_async_staleness1_learning_sanity_fsdp_tp():
+    _learning_sanity(MIX_MESH, "async1_mix")
+
+
+@pytest.mark.slow
+def test_async_actor_fraction_device_subset():
+    """actor_fraction < 1 places the engine on its own dp-only submesh
+    (8 virtual CPU devices → 4 actor devices): weight pushes reshard
+    learner→actor, harvest groups reshard actor→learner at landing,
+    and the phase trains to finite stats."""
+    trainer = _cached_trainer(
+        "async_frac", DP_MESH,
+        async_rl={
+            "enabled": True, "staleness_window": 1, "actor_fraction": 0.5,
+        },
+    )
+    init = jax.device_get(trainer.state)
+    params, rows, kl_seq, n_up = _run_phase(trainer, init)
+    engine = trainer.rollout_engine_obj
+    assert trainer._actor_mesh is not None
+    n_total = len(jax.devices())
+    assert (
+        dict(engine.mesh.shape)["dp"] == max(1, int(round(0.5 * n_total)))
+    )
+    assert n_up == 4
+    for key, v in rows.items():
+        assert np.isfinite(v).all(), key

@@ -364,12 +364,23 @@ def _run_streamed_phase(trainer, prompts, seed=3):
     return n_up, by_query
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mesh", PARITY_MESHES)
 def test_full_streamed_phase_parity(mesh):
     """Acceptance pin: with rollout.engine continuous, a full streamed
     PPO phase (epoch-1 dispatch through the landing hook included)
     produces per-row token-identical rollouts to the fixed-batch sampler
-    on the same prompt set."""
+    on the same prompt set.
+
+    Nightly tier since PR 11 (it was the heaviest remaining tier-1
+    call at 14.3 s; ROADMAP tier-1 budget note). The tier-1 canaries:
+    test_engine_matches_fixed_sampler_rows[dp] pins per-row
+    engine-vs-fixed token parity + the slot-lifecycle accounting, and
+    tests/test_async_rl.py::test_async_staleness0_bitwise_parity_canary
+    pins the full engine-collected streamed phase (landing hook,
+    version-tagged store, epoch-1 dispatch, residual epochs) BITWISE
+    against the serial same-plan run — a strict superset of the
+    phase-integration surface this test exercises."""
     mesh_id = "dp" if mesh == DP_MESH else ("sp" if "sp" in mesh else "mix")
     fixed, cont = _trainer_pair(mesh, mesh_id)
     rng = np.random.default_rng(21)

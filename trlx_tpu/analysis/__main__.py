@@ -32,6 +32,19 @@ def _force_cpu_platform() -> None:
     os.environ["XLA_FLAGS"] = flags
 
 
+def _emit_smoke(summary, format_smoke_text, as_json: bool) -> int:
+    """Shared tail of every ``--*-smoke`` mode: print the summary (JSON
+    or text) and map ``passed`` to the exit code — one place to fix the
+    contract instead of one copy per smoke."""
+    import json as _json
+
+    if as_json:
+        print(_json.dumps(summary, default=str))
+    else:
+        print(format_smoke_text(summary))
+    return 0 if summary["passed"] else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m trlx_tpu.analysis",
@@ -133,6 +146,31 @@ def main(argv=None) -> int:
         metavar="NAMES",
         default=None,
         help="with --chaos-smoke: comma-separated subset of scenarios "
+        "to run (default: all)",
+    )
+    parser.add_argument(
+        "--async-smoke",
+        action="store_true",
+        help="instead of the rule engines: self-check for the "
+        "asynchronous actor–learner path (docs/async_pipeline.md) — a "
+        "staleness_window=0 async phase must be bitwise-identical to "
+        "the serial same-plan phase with zero weight pushes, and a "
+        "planted dead actor (engine.admit chaos) must surface an "
+        "actor-dead health event and recover via the resilience "
+        "supervisor with no hang; exit 1 when any scenario fails",
+    )
+    parser.add_argument(
+        "--async-workdir",
+        metavar="DIR",
+        default=None,
+        help="with --async-smoke: scratch directory for the scenarios' "
+        "checkpoints (default: a temp dir)",
+    )
+    parser.add_argument(
+        "--async-scenarios",
+        metavar="NAMES",
+        default=None,
+        help="with --async-smoke: comma-separated subset of scenarios "
         "to run (default: all)",
     )
     parser.add_argument(
@@ -270,8 +308,6 @@ def main(argv=None) -> int:
 
     if args.chaos_smoke:
         _force_cpu_platform()
-        import json as _json
-
         from trlx_tpu.analysis.chaos_smoke import (
             format_smoke_text,
             run_chaos_smoke,
@@ -283,27 +319,32 @@ def main(argv=None) -> int:
             else None
         )
         summary = run_chaos_smoke(workdir=args.chaos_workdir, only=only)
-        if args.json:
-            print(_json.dumps(summary, default=str))
-        else:
-            print(format_smoke_text(summary))
-        return 0 if summary["passed"] else 1
+        return _emit_smoke(summary, format_smoke_text, args.json)
+
+    if args.async_smoke:
+        _force_cpu_platform()
+        from trlx_tpu.analysis.async_smoke import (
+            format_smoke_text,
+            run_async_smoke,
+        )
+
+        only = (
+            [s.strip() for s in args.async_scenarios.split(",") if s.strip()]
+            if args.async_scenarios
+            else None
+        )
+        summary = run_async_smoke(workdir=args.async_workdir, only=only)
+        return _emit_smoke(summary, format_smoke_text, args.json)
 
     if args.health_smoke:
         _force_cpu_platform()
-        import json as _json
-
         from trlx_tpu.analysis.health_smoke import (
             format_smoke_text,
             run_health_smoke,
         )
 
         summary = run_health_smoke(dump_dir=args.health_dump_dir)
-        if args.json:
-            print(_json.dumps(summary, default=str))
-        else:
-            print(format_smoke_text(summary))
-        return 0 if summary["passed"] else 1
+        return _emit_smoke(summary, format_smoke_text, args.json)
 
     if args.perf_audit:
         _force_cpu_platform()

@@ -515,7 +515,85 @@ def trace_trainer(
         # (docs/inference.md) — traced once on the ppo trainer (every
         # causal family shares the same engine code path)
         programs.extend(_trace_engine_programs(trainer, kind, mesh_shape))
+        # the async actor–learner programs (docs/async_pipeline.md),
+        # traced once on the ppo trainer (the only kind the async mode
+        # composes with today): the mid-generation weight push the
+        # actors receive, and the stream store's donating versioned
+        # landing program
+        programs.extend(_trace_async_programs(trainer, kind, mesh_shape))
     return programs
+
+
+def _trace_async_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram]:
+    """Trace the asynchronous actor–learner path's jitted programs
+    (``trlx_tpu/trainer/async_rl.py``, docs/async_pipeline.md):
+
+    - ``async_weight_push`` — the refreshed behavior policy pushed to
+      the actors MID-generation (compute-dtype cast + donation-safe
+      per-leaf copy; a separate jit instance from the phase-start
+      snapshot, so the program the async path actually dispatches is
+      what gets audited);
+    - ``versioned_land`` — the stream store's landing program
+      (``pipeline/ppo_buffer.py::land_rows``): one fused, store-DONATING
+      ``dynamic_update_slice`` write of a harvest chunk at a dynamic
+      offset (the device half of the version-tagged landing; the
+      version column itself is host-side plan metadata).
+
+    Traced regardless of the configured ``train.async_rl`` — like the
+    engine programs, the audit covers the async path even while a run
+    defaults to synchronous.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.parallel.mesh import batch_sharding
+    from trlx_tpu.pipeline import ppo_buffer
+
+    axes = set(trainer.mesh.axis_names)
+    batch_sh = batch_sharding(trainer.mesh)
+    params_sds = _sds(trainer.state.params)
+    mb = _ppo_minibatch_sds(trainer)
+    # a two-chunk stream store with one harvest-chunk landing at a
+    # dynamic offset — the steady-state shape pair of a streamed phase
+    store_sds = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct((2 * x.shape[0],) + x.shape[1:], x.dtype),
+        mb,
+    )
+    offset_sds = jax.ShapeDtypeStruct((), jnp.int32)
+    land_args = (store_sds, mb, offset_sds)
+    return [
+        TracedProgram(
+            subject=f"{kind}.async_weight_push",
+            closed_jaxpr=jax.make_jaxpr(trainer._weight_push_jit)(
+                params_sds
+            ),
+            mesh_axes=axes,
+            input_paths=flat_input_paths(params_sds, prefixes=("params",)),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                (params_sds,), (trainer.state_shardings.params,)
+            ),
+            def_site=callable_def_site(trainer._weight_push_jit),
+        ),
+        TracedProgram(
+            subject=f"{kind}.versioned_land",
+            closed_jaxpr=jax.make_jaxpr(ppo_buffer._land_rows_jit)(
+                *land_args
+            ),
+            mesh_axes=axes,
+            n_donated_state_leaves=len(
+                jax.tree_util.tree_leaves(store_sds)
+            ),
+            input_paths=flat_input_paths(
+                *land_args, prefixes=("store", "chunk", "offset")
+            ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                land_args, (batch_sh, batch_sh, None)
+            ),
+            def_site=callable_def_site(ppo_buffer._land_rows_jit),
+        ),
+    ]
 
 
 def _trace_engine_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram]:

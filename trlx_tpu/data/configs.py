@@ -230,6 +230,23 @@ class TrainConfig:
     # default and the parity baseline.
     rollout: Dict[str, Any] = field(default_factory=dict)
 
+    # Asynchronous actor–learner PPO (docs/async_pipeline.md):
+    # {"enabled": true, "staleness_window": 1, "actor_fraction": 1.0} —
+    # parsed into trlx_tpu.trainer.async_rl.AsyncRLConfig. With enabled
+    # (requires rollout.engine: continuous), the phase barrier between
+    # collect and train is removed: actors stream version-tagged
+    # rollouts through the stream store while the learner consumes
+    # planned minibatches as they land and pushes refreshed weights to
+    # the actors MID-GENERATION, bounded by staleness_window (the
+    # version-lag guard defers consumption that would exceed it; the
+    # staleness-breach health detector is the circuit-breaker).
+    # staleness_window: 0 is the bitwise-serial degenerate mode — the
+    # async schedule is then bit-identical to the serial same-plan
+    # phase (tests/test_async_rl.py). actor_fraction < 1 places the
+    # engine on its own device subset (the single-process rehearsal of
+    # multi-host actor/learner placement). Default off: nothing changes.
+    async_rl: Dict[str, Any] = field(default_factory=dict)
+
     # Streamed collect→train phase overlap (PPO-family trainers;
     # docs/async_pipeline.md): the behavior policy is snapshotted once per
     # phase, rollout chunks land incrementally in the streaming buffer, and

@@ -38,11 +38,28 @@ as ``ppo.engine_prefill`` / ``ppo.engine_decode_step`` /
   rollouts and mark the slots free (the admission queue refills them on
   the next poll).
 
-Host loop cost model: one small [B]-bool device->host fetch per decode
-step (the admission decision needs last step's flags — "the step after
-eos"). The fetch is started asynchronously right behind the dispatch;
-on hardware the admission lag can be widened to k steps by polling
-every k-th step (slots then idle at most k-1 extra steps).
+Host loop cost model: one small [B]-bool device->host fetch per
+``done_poll_interval`` decode steps (the admission decision needs the
+flags; they are *sticky* — a finished slot stays done until harvested —
+so polling only the latest step's flags every k-th step is exact). The
+fetch is started asynchronously right behind each dispatch; at k=1 the
+loop is bitwise-identical to polling every step (the parity contract,
+tests/test_async_rl.py), at k>1 the fetch round-trip amortizes over k
+dispatches and slots idle at most k-1 extra steps before harvest (the
+group composition may then differ — per-row tokens never do).
+
+Asynchronous actor–learner support (``train.async_rl``,
+docs/async_pipeline.md): :meth:`push_weights` hands the engine a
+refreshed behavior policy **mid-generation** — the swap is deferred to
+the drive loop's safe point (after harvest bookkeeping, before the next
+admission) so a push landing between a harvest and its refill can never
+drop the queued admit group; rows are tagged with the params version
+they were admitted under. :meth:`min_inflight_version` over those tags
+is what the learner's bounded-staleness guard checks before each
+update, and every harvest group carries the tags out to the stream
+store's version column, where the learner reads them back as the
+``async/consumed_lag`` attribution (how many updates old each consumed
+minibatch's data is).
 """
 
 from __future__ import annotations
@@ -99,6 +116,8 @@ class EngineStats:
     recycles: int = 0
     occupancy_sum: int = 0  # sum over steps of active slots
     num_slots: int = 0
+    done_polls: int = 0  # [B]-bool device->host fetches actually paid
+    weight_pushes: int = 0  # mid-generation behavior refreshes applied
 
     @property
     def slot_util(self) -> float:
@@ -113,6 +132,8 @@ class EngineStats:
             "engine/decode_steps": float(self.decode_steps),
             "engine/slot_recycles": float(self.recycles),
             "engine/slot_util": round(self.slot_util, 4),
+            "engine/done_polls": float(self.done_polls),
+            "engine/weight_pushes": float(self.weight_pushes),
         }
 
 
@@ -134,6 +155,11 @@ class ContinuousBatchingEngine:
         chunk size downstream consumers compile at. Must be <= num_slots.
     :param block_size: requested paged-KV block size (shrunk to divide
         Q + max_new_tokens).
+    :param done_poll_interval: fetch the [B] ``done`` flags every k-th
+        decode step (flags are sticky, so the latest fetch is exact);
+        k=1 — the default — reproduces the poll-every-step loop
+        bitwise, k>1 amortizes the host round-trip over k dispatches at
+        the cost of up to k-1 idle steps per finished slot.
     :param mesh / param_shardings / cache_sharding: optional GSPMD
         pinning; ``cache_sharding`` shards the capacity axis (sp).
     """
@@ -150,6 +176,7 @@ class ContinuousBatchingEngine:
         admit_width: int = 0,
         harvest_width: int = 0,
         block_size: int = 16,
+        done_poll_interval: int = 1,
         mesh=None,
         param_shardings=None,
         cache_sharding=None,
@@ -164,6 +191,11 @@ class ContinuousBatchingEngine:
         self.block_size = choose_block_size(self.capacity, block_size)
         self.n_blocks = self.capacity // self.block_size
         self.with_values = with_values
+        self.done_poll_interval = int(done_poll_interval)
+        if self.done_poll_interval < 1:
+            raise ValueError(
+                f"done_poll_interval={done_poll_interval} must be >= 1"
+            )
         self._apply_fn = apply_fn
         self._init_cache_fn = init_cache_fn
         self.mesh = mesh
@@ -212,6 +244,14 @@ class ContinuousBatchingEngine:
         self._done_slots: List[int] = []
         self._recycle_counts = np.zeros(self.num_slots, np.int64)
         self._next_row = 0
+        # behavior-policy versioning (async actor–learner): every slot
+        # records the params version it was admitted under; push_weights
+        # stages a refresh that the drive loop applies at its safe point
+        self.param_version = 0
+        self._slot_versions = np.zeros(self.num_slots, np.int64)
+        self._pending_params = None
+        self._pending_version: Optional[int] = None
+        self._steps_since_poll = 0
         self.stats = EngineStats(num_slots=self.num_slots)
 
     # ------------------------- jitted programs ------------------------- #
@@ -550,7 +590,61 @@ class ContinuousBatchingEngine:
         self._done_slots = []
         self._recycle_counts[:] = 0
         self._next_row = row_start
+        self.param_version = 0
+        self._slot_versions[:] = 0
+        self._pending_params = None
+        self._pending_version = None
+        self._steps_since_poll = 0
         self.stats = EngineStats(num_slots=self.num_slots)
+
+    def push_weights(self, params, version: Optional[int] = None) -> None:
+        """Stage a refreshed behavior policy for in-flight application
+        (PipelineRL-style mid-generation weight update). The swap itself
+        happens at the drive loop's safe point — after harvest
+        bookkeeping, before the next admission — NEVER here: a push
+        landing between a harvest and its refill must not disturb the
+        queued admit group or the freed-slot bookkeeping (the admission
+        starvation edge pinned in tests/test_async_rl.py). Rows already
+        decoding continue from their current position under the new
+        params (their recorded per-token logprobs remain the true
+        behavior logprobs — PPO's importance ratio corrects the rest);
+        rows admitted after the swap are tagged with the new version.
+
+        ``params`` must own its buffers (the learner's masters are
+        donated by every train step — push a snapshot/copy, not the
+        live tree). Consecutive pushes before the next safe point
+        coalesce: only the newest params are ever applied."""
+        self._pending_params = params
+        self._pending_version = (
+            int(version) if version is not None else self.param_version + 1
+        )
+
+    def _apply_pending_push(self) -> None:
+        if self._pending_params is None:
+            return
+        self._params = self._pending_params
+        self.param_version = self._pending_version
+        self._pending_params = None
+        self._pending_version = None
+        self.stats.weight_pushes += 1
+
+    def min_inflight_version(self) -> Optional[int]:
+        """Oldest behavior version any not-yet-harvested work will carry:
+        the min admission version over busy/done-awaiting-harvest slots,
+        and — when prompts are still queued — the version they WILL be
+        admitted under (the current one, or a staged push's). ``None``
+        when nothing is in flight (the bounded-staleness guard is then
+        vacuous)."""
+        # _busy_rows covers decoding AND done-awaiting-harvest slots
+        # (slots leave it only at harvest), so one pass covers both
+        versions = [int(self._slot_versions[s]) for s in self._busy_rows]
+        if self._queue:
+            versions.append(
+                self._pending_version
+                if self._pending_params is not None
+                else self.param_version
+            )
+        return min(versions) if versions else None
 
     def submit(self, prompt_ids, prompt_mask) -> List[int]:
         """Enqueue prompts (host arrays, [n, Q]); returns their global
@@ -603,6 +697,9 @@ class ContinuousBatchingEngine:
                     row_index[i] = row
                     turns[i] = self._recycle_counts[slot]
                     self._busy_rows[slot] = row
+                    # behavior-version tag: the params this row's whole
+                    # prefill (and its first decode steps) run under
+                    self._slot_versions[slot] = self.param_version
                 args = (prompt_ids, prompt_mask)
                 if self.mesh is not None:
                     from trlx_tpu.parallel.mesh import batch_sharding
@@ -637,6 +734,7 @@ class ContinuousBatchingEngine:
                     self._state, jnp.asarray(slots, jnp.int32)
                 )
             rows = [self._busy_rows.pop(s) for s in slots]
+            versions = [int(self._slot_versions[s]) for s in slots]
             for s in slots:
                 self._recycle_counts[s] += 1
                 self._free.append(s)
@@ -644,6 +742,9 @@ class ContinuousBatchingEngine:
             self.stats.completed += C
             outs = dict(outs)
             outs["rows"] = rows  # host-side draw indices, harvest order
+            # host-side behavior-version tag per row (admission version):
+            # the stream store's version column / staleness accounting
+            outs["versions"] = versions
             yield outs
 
     def drive(self, target: int) -> Iterator[Dict[str, Any]]:
@@ -663,12 +764,18 @@ class ContinuousBatchingEngine:
                 "are pending — submit the phase's prompts first"
             )
         yielded = 0
+        self._steps_since_poll = 0
         while yielded < target:
             for group in self._harvest_ready():
                 yield group
                 yielded += len(group["rows"])
                 if yielded >= target:
                     return
+            # safe point for a staged weight push (async actor–learner):
+            # harvest bookkeeping is settled and the queued admit group
+            # is about to prefill under the refreshed params — a push
+            # can never drop or reorder it
+            self._apply_pending_push()
             self._admit()
             if not self._busy_rows:
                 # nothing decoding and nothing harvestable: the queue
@@ -687,7 +794,17 @@ class ContinuousBatchingEngine:
                 pass
             self.stats.decode_steps += 1
             self.stats.occupancy_sum += len(self._busy_rows)
+            # amortized done polling: the flags are sticky (a finished
+            # slot stays done until harvested), so fetching only every
+            # k-th step's flags is exact — k=1 reproduces the
+            # poll-every-step loop bitwise, and the async copy above has
+            # k dispatches to land the transfer before the host reads it
+            self._steps_since_poll += 1
+            if self._steps_since_poll < self.done_poll_interval:
+                continue
+            self._steps_since_poll = 0
             done_host = np.asarray(jax.device_get(done))
+            self.stats.done_polls += 1
             for slot, row in list(self._busy_rows.items()):
                 if done_host[slot] and slot not in self._done_slots:
                     self._done_slots.append(slot)

@@ -22,7 +22,7 @@ from __future__ import annotations
 import os
 import sys
 import time
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
@@ -100,6 +100,22 @@ class PPOOrchestrator(Orchestrator):
             from trlx_tpu.utils.async_writer import BackgroundJSONLWriter
 
             self._rollout_writer = BackgroundJSONLWriter()
+        # marker distinguishing ENGINE-layer failures (dead actor) from
+        # learner/reward-path failures inside the continuous collect
+        # loop — set by _engine_step, consumed by make_experience
+        self._engine_error: Optional[BaseException] = None
+
+    def _engine_step(self, fn, *args, **kwargs):
+        """Run one engine call (start_phase/submit/drive-next), marking
+        any failure as engine-originated so ``make_experience`` can tell
+        a dead actor from a learner-side bug raised in the same loop."""
+        try:
+            return fn(*args, **kwargs)
+        except StopIteration:
+            raise
+        except BaseException as e:
+            self._engine_error = e
+            raise
 
     def _draw(self):
         """One prompt-batch draw from the infinite stream (counted for
@@ -280,8 +296,66 @@ class PPOOrchestrator(Orchestrator):
 
                 if isinstance(e, (HealthAbort, PreemptionDrain)):
                     raise  # policy decisions, not engine-path failures
+                async_cfg = getattr(self.trainer, "async_config", None)
+                if async_cfg is not None and async_cfg.enabled:
+                    # async actor–learner mode: an ENGINE-layer failure
+                    # (submit/drive raised — the marker set by
+                    # _engine_step below) is a dead/stalled actor — not
+                    # a reason to silently retrain on the fixed sampler,
+                    # which would change the workload's whole schedule
+                    # mid-run. Surface it and hand recovery to the PR-9
+                    # supervisor (docs/resilience.md). Anything else —
+                    # a learner dispatch, the user reward fn — must
+                    # propagate AS ITSELF so the supervisor's
+                    # permanent-vs-retriable taxonomy judges the real
+                    # error (wrapping a deterministic reward-fn bug as
+                    # retriable would burn the restart budget replaying
+                    # it).
+                    if self._engine_error is e:
+                        self._engine_error = None
+                        self._actor_dead(e, iter_count)
+                    self._engine_error = None
+                    raise
+                self._engine_error = None
                 self._degrade_engine(e, iter_count)
         return self._make_experience_fixed(num_rollouts, iter_count)
+
+    def _actor_dead(self, error: BaseException, iter_count: int) -> None:
+        """Async actor–learner failure path: emit an ``actor-dead``
+        health event (the ``engine-fallback`` pattern) and raise
+        :class:`~trlx_tpu.trainer.async_rl.ActorDeadError`, which the
+        resilience supervisor classifies retriable — restart from the
+        last good checkpoint with a fresh actor pool, no hang. The
+        active streamed phase is aborted by the raise's unwind
+        (:meth:`PPOTrainer._collect_phase`), exactly like any other
+        collection failure."""
+        from trlx_tpu.trainer.async_rl import ActorDeadError
+
+        tr = self.trainer
+        print(
+            "resilience: async actor died mid-phase "
+            f"({type(error).__name__}: {error}) — raising for the "
+            "supervisor (restart from the last good checkpoint)",
+            file=sys.stderr,
+        )
+        emit = getattr(tr, "emit_health_event", None)
+        if emit is not None:
+            emit(
+                detector="actor-dead",
+                severity="error",
+                series="async",
+                message=(
+                    "async actor (continuous engine) died mid-phase "
+                    f"({type(error).__name__}: {error}); supervisor "
+                    "restart requested"
+                ),
+                step=iter_count,
+                phase=getattr(tr, "health_phase_id", None),
+            )
+        raise ActorDeadError(
+            f"async actor died mid-phase at iteration {iter_count} "
+            f"({type(error).__name__}: {error})"
+        ) from error
 
     def _degrade_engine(self, error: BaseException, iter_count: int) -> None:
         """Fall back from the continuous engine to the fixed sampler for
@@ -400,8 +474,18 @@ class PPOOrchestrator(Orchestrator):
         ):
             try:
                 with telemetry.span("collect/dispatch", force=True) as sp:
-                    engine.start_phase(
-                        self.trainer.rollout_params(),
+                    # engine_start_params reshards the behavior snapshot
+                    # to the actor device subset when one is configured
+                    # (async_rl.actor_fraction); otherwise it IS
+                    # rollout_params()
+                    start_params = (
+                        self.trainer.engine_start_params()
+                        if hasattr(self.trainer, "engine_start_params")
+                        else self.trainer.rollout_params()
+                    )
+                    self._engine_step(
+                        engine.start_phase,
+                        start_params,
                         self.trainer.rollout_phase_key(),
                     )
                     # draw the phase's prompts into the admission queue
@@ -410,7 +494,8 @@ class PPOOrchestrator(Orchestrator):
                         with telemetry.span("collect/prompt_draw"):
                             batch, meta = self._draw()
                         batch, meta = self._expand_groups(batch, meta)
-                        rows = engine.submit(
+                        rows = self._engine_step(
+                            engine.submit,
                             np.asarray(batch.input_ids),
                             np.asarray(batch.attention_mask),
                         )
@@ -421,7 +506,34 @@ class PPOOrchestrator(Orchestrator):
                             )
                 dispatch_time += sp.duration_ms / 1000.0
 
-                for group in engine.drive(target):
+                # drive() interleaves engine decode with the learner's
+                # landing hook (score/rewards/epoch-1 dispatch) in one
+                # loop; pulling groups through _engine_step keeps the
+                # engine-failure marker scoped to the generator's own
+                # raises, not the loop body's
+                drive_iter = iter(engine.drive(target))
+                while True:
+                    try:
+                        group = self._engine_step(next, drive_iter)
+                    except StopIteration:
+                        break
+                    if getattr(self.trainer, "_actor_mesh", None) is not None:
+                        # actor→learner rollout stream (async device
+                        # subsets): one batched reshard of the harvest
+                        # group from the actor submesh onto the
+                        # learner's batch sharding, before anything
+                        # downstream consumes it
+                        import jax
+
+                        keys = (
+                            "query_tokens", "query_mask", "tokens",
+                            "response_mask", "logprobs", "values",
+                        )
+                        moved = jax.device_put(
+                            {k: group[k] for k in keys},
+                            self.trainer._batch_sh,
+                        )
+                        group = dict(group, **moved)
                     # frozen-ref forward queued right behind the harvest;
                     # it runs on device while Python scores the group
                     ref_logprobs = self.trainer.score_ref(
@@ -465,7 +577,12 @@ class PPOOrchestrator(Orchestrator):
                                 logprobs=group["logprobs"],
                                 values=group["values"],
                                 rewards=rewards,
-                            )
+                            ),
+                            # behavior-version tags (host ints, from the
+                            # engine's admission versions): the async
+                            # learner's staleness accounting; all-zero
+                            # outside async mode (no pushes ever happen)
+                            versions=group.get("versions"),
                         )
                         collected += len(rows)
                         land_sp.set(landed=collected)

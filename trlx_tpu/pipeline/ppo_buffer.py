@@ -138,6 +138,29 @@ def _write_rows(
     )
 
 
+def land_rows(
+    store: PPORolloutBatch, chunk: PPORolloutBatch, offset
+) -> PPORolloutBatch:
+    """The stream store's landing program: one fused, **store-donating**
+    write of a rollout chunk at a dynamic ``offset``. Same
+    ``dynamic_update_slice`` discipline as :func:`_write_rows` (bitwise-
+    identical results), but jitted with the store donated so each
+    landing updates the existing buffers in place instead of allocating
+    a fresh full-capacity store per chunk — the store is the collect
+    phase's largest host-loop allocation, and under the async
+    actor–learner schedule landings and train steps interleave on the
+    same HBM high-water mark. ``offset`` is a device scalar so every
+    landing of a phase shares ONE compiled program (a python-int offset
+    would bake a program per landing position). Traced by the analysis
+    harness as ``ppo.versioned_land`` — the device half of the
+    version-tagged landing (the version column itself is host-side
+    plan metadata, like the minibatch indices)."""
+    return _write_rows(store, chunk, offset)
+
+
+_land_rows_jit = jax.jit(land_rows, donate_argnums=(0,))
+
+
 class PPORolloutBuffer(BaseRolloutStore):
     """Accumulates fixed-shape rollout chunks; serves shuffled minibatches."""
 
@@ -148,6 +171,12 @@ class PPORolloutBuffer(BaseRolloutStore):
         self._capacity = 0
         self._landed = 0
         self._streaming = False
+        # host-side behavior-version tag per landed row (async
+        # actor–learner, docs/async_pipeline.md): plan metadata like the
+        # minibatch indices — never crosses to device, so the staleness
+        # guard's comparisons are plain host ints (no host-branch hazard)
+        self._row_versions: Optional[np.ndarray] = None
+        self._chunk_versions: List[np.ndarray] = []
 
     def begin_stream(self, capacity: int) -> None:
         """Switch to incremental stream mode for the coming phase.
@@ -167,20 +196,41 @@ class PPORolloutBuffer(BaseRolloutStore):
         self._capacity = int(capacity)
         self._landed = 0
         self._full = None
+        self._row_versions = np.zeros(self._capacity, np.int64)
+        self._chunk_versions = []
 
     @property
     def streaming(self) -> bool:
         return self._streaming
 
-    def push(self, batch: PPORolloutBatch) -> None:
+    def push(self, batch: PPORolloutBatch, versions=None) -> None:
+        """Append one rollout chunk. ``versions`` (optional, host ints of
+        length ``batch_size``) tags each row with the behavior-policy
+        version that generated it — the async actor–learner's staleness
+        accounting reads the tags back via :meth:`row_versions`;
+        untagged chunks default to version 0 (the phase snapshot)."""
+        n = batch.batch_size
+        v = (
+            np.zeros(n, np.int64)
+            if versions is None
+            else np.asarray(versions, np.int64)
+        )
+        if v.shape != (n,):
+            raise ValueError(
+                f"versions must be [{n}] host ints, got shape {v.shape}"
+            )
         if not self._streaming:
             self._chunks.append(batch)
+            self._chunk_versions.append(v)
             self._full = None
             return
-        n = batch.batch_size
         if self._store is None:
             self._store = _alloc_store(batch, max(self._capacity, n))
             self._capacity = self._store.batch_size
+            if len(self._row_versions) < self._capacity:
+                self._row_versions = np.resize(
+                    self._row_versions, self._capacity
+                )
         if self._landed + n > self._capacity:
             # a non-dividing final chunk overshoots the planned capacity:
             # grow the store (same dynamic_update_slice discipline). The
@@ -204,7 +254,14 @@ class PPORolloutBuffer(BaseRolloutStore):
             grown = _alloc_store(batch, new_capacity)
             grown = _write_rows(grown, self._store, 0)
             self._store, self._capacity = grown, new_capacity
-        self._store = _write_rows(self._store, batch, self._landed)
+            self._row_versions = np.resize(self._row_versions, new_capacity)
+        # the donating jitted landing (one compiled program per phase;
+        # in-place store update instead of a fresh full-capacity
+        # allocation per chunk — see land_rows)
+        self._store = _land_rows_jit(
+            self._store, batch, jnp.int32(self._landed)
+        )
+        self._row_versions[self._landed : self._landed + n] = v
         self._landed += n
         self._full = None
 
@@ -216,6 +273,8 @@ class PPORolloutBuffer(BaseRolloutStore):
         self._capacity = 0
         self._landed = 0
         self._streaming = False
+        self._row_versions = None
+        self._chunk_versions = []
 
     @property
     def full(self) -> PPORolloutBatch:
@@ -242,6 +301,25 @@ class PPORolloutBuffer(BaseRolloutStore):
         if self._streaming:
             return self._landed
         return sum(c.batch_size for c in self._chunks)
+
+    def row_versions(self, idx) -> np.ndarray:
+        """Behavior-policy version tag per row of ``idx`` (host ints, any
+        shape). Rows pushed untagged read as version 0."""
+        idx = np.asarray(idx)
+        if self._streaming:
+            if self._row_versions is None:
+                raise ValueError("rollout buffer is empty")
+            # idx is HOST numpy by contract (plan indices), same as
+            # gather's guard: no device value is ever branched on
+            if idx.size and int(idx.max()) >= self._landed:  # tpu-lint: disable=host-branch
+                raise ValueError(
+                    f"row_versions of row {int(idx.max())} but only "
+                    f"{self._landed} rollouts have landed"
+                )
+            return self._row_versions[idx]
+        if not self._chunks:
+            raise ValueError("rollout buffer is empty")
+        return np.concatenate(self._chunk_versions)[idx]
 
     def gather(self, idx: np.ndarray, sharding=None) -> PPORolloutBatch:
         """Device-side gather of rows by index — ``idx`` may be [B] (one

@@ -98,15 +98,20 @@ def failure_kind(error: BaseException) -> str:
     HealthAbort is retriable by design: the detector already dumped the
     forensics file, and the whole point of ``on_error: abort`` under a
     supervisor is "stop digging, restore the last good checkpoint".
-    NaN-divergence RuntimeErrors and every other deterministic failure
-    stay permanent — replaying them from a checkpoint written *before*
-    the divergence re-fails identically.
+    ActorDeadError (async actor–learner, docs/async_pipeline.md) is
+    retriable for the same reason: the orchestrator already emitted the
+    ``actor-dead`` health event, the learner's checkpoint is intact,
+    and a restart rebuilds the actor pool from scratch — the dead-actor
+    recovery story. NaN-divergence RuntimeErrors and every other
+    deterministic failure stay permanent — replaying them from a
+    checkpoint written *before* the divergence re-fails identically.
     """
     from trlx_tpu.telemetry.health import HealthAbort
+    from trlx_tpu.trainer.async_rl import ActorDeadError
 
     if isinstance(error, PreemptionDrain):
         return "preemption"
-    if isinstance(error, HealthAbort):
+    if isinstance(error, (HealthAbort, ActorDeadError)):
         return "retriable"
     if not isinstance(error, Exception):
         return "permanent"  # KeyboardInterrupt / SystemExit: never eat

@@ -109,6 +109,19 @@ DEFAULT_DETECTORS: Dict[str, Dict[str, Any]] = {
     "nan-precursor": dict(
         series=(), kind="nonfinite", severity="error", huge=1e8,
     ),
+    # async actor–learner circuit-breaker (docs/async_pipeline.md): the
+    # per-phase max rollout staleness (learner updates ahead of the
+    # oldest consumed row's behavior policy). The version-lag guard
+    # should make a breach impossible; a trip therefore means the guard
+    # or the version tagging is broken — error severity so the
+    # health.on_error policy (warn/dump/abort) is the breaker. The
+    # effective threshold is injected from train.async_rl's
+    # staleness_window when async RL is enabled (BaseRLTrainer._setup_
+    # health); the registry default never trips on its own.
+    "staleness-breach": dict(
+        series=("async/staleness",),
+        kind="above", severity="error", threshold=1e9,
+    ),
 }
 
 

@@ -80,7 +80,22 @@ class BaseRLTrainer(ABC):
         jitted steps, so it is set before any program is built."""
         from trlx_tpu.telemetry.health import HealthConfig
 
-        self.health_config = HealthConfig.from_dict(self.config.train.health)
+        health_dict = dict(self.config.train.health or {})
+        async_dict = dict(getattr(self.config.train, "async_rl", None) or {})
+        if async_dict.get("enabled") and health_dict.get("enabled"):
+            # async actor–learner circuit-breaker: the staleness-breach
+            # detector's threshold IS the configured staleness window
+            # unless the user tuned it explicitly — a guard bug (not
+            # ordinary operation) is the only way to cross it
+            detectors = dict(health_dict.get("detectors") or {})
+            if "staleness-breach" not in detectors:
+                detectors["staleness-breach"] = {
+                    "threshold": float(
+                        async_dict.get("staleness_window", 1)
+                    )
+                }
+                health_dict["detectors"] = detectors
+        self.health_config = HealthConfig.from_dict(health_dict)
         self._health_enabled = bool(self.health_config.enabled)
         self._health_ev = True  # GRPO opts out (placeholder returns slot)
         self.health_monitor = None

@@ -86,6 +86,13 @@ class ILQLTrainer(BaseRLTrainer):
                 f"{train.rollout.get('engine')!r} is not supported by "
                 "ILQLTrainer (offline trainer; no rollout engine)"
             )
+        if (train.async_rl or {}).get("enabled"):
+            # same loudness: no collect phase to disaggregate
+            raise NotImplementedError(
+                "train.async_rl is not supported by ILQLTrainer "
+                "(offline trainer; there is no actor/collect loop to "
+                "run asynchronously)"
+            )
         self.mesh = make_mesh(train.mesh)
         self.pp_stages = dict(self.mesh.shape).get("pp", 1)
         self.pp_microbatches = train.pp_microbatches

@@ -137,6 +137,32 @@ class _StreamedPhase:
         self.dispatched_during_collect = 0
 
 
+class _AsyncStreamedPhase(_StreamedPhase):
+    """The asynchronous actor–learner phase's extra host state
+    (trainer/async_rl.py): the learner's update-version counter, the
+    per-consumed-minibatch staleness record, guard-hold / learner-busy
+    wall time (the ``async/*`` attribution stats), and the weight-push
+    count. The underlying plan/dispatch machinery is the streamed
+    phase's — async is a *policy* over when dispatches happen and what
+    params the actors hold, never a different schedule."""
+
+    def __init__(self, plan: StreamPlan, overlap: bool):
+        super().__init__(plan, overlap)
+        self.learner_version = 0
+        self.staleness: List[int] = []  # in-flight lag per consumed mb
+        self.consumed_lag: List[int] = []  # row age at consumption
+        self.weight_pushes = 0
+        self.guard_hold_ms = 0.0  # row-ready time spent behind the guard
+        self.t_guard_hold: Optional[float] = None
+        self.learner_busy_ms = 0.0  # epoch-1 dispatch spans (+ residual)
+        self.t_begin = telemetry.monotonic()
+        # set by finish_streamed_phase before the forced drain: rollouts
+        # still in flight then (a chunk-rounded over-submission) can
+        # never land into THIS plan, so they neither hold the staleness
+        # accounting nor deserve further weight pushes
+        self.collect_done = False
+
+
 @register_trainer
 class PPOTrainer(BaseRLTrainer):
     # param-tree key holding the (KL-reference) backbone
@@ -235,6 +261,21 @@ class PPOTrainer(BaseRLTrainer):
         self.rollout_engine = self.rollout_config.engine
         if self.rollout_engine == "continuous":
             self._validate_continuous_engine()
+        # Asynchronous actor–learner mode (train.async_rl,
+        # trainer/async_rl.py, docs/async_pipeline.md): the streamed
+        # phase gains version-tagged rollouts, a bounded-staleness
+        # version-lag guard, and in-flight weight pushes to the engine.
+        # Parsed here (after the rollout engine) because async requires
+        # the continuous engine — the actors ARE the engine.
+        from trlx_tpu.trainer.async_rl import AsyncRLConfig
+
+        self.async_config = AsyncRLConfig.from_dict(train.async_rl)
+        if self.async_config.enabled:
+            self._validate_async_rl()
+        # actor device-subset state (async_rl.actor_fraction < 1): built
+        # lazily with the engine; None = actors share the trainer mesh
+        self._actor_mesh = None
+        self._actor_param_shardings = None
         if self.rollout_config.rows_per_row_rng:
             import dataclasses
 
@@ -889,6 +930,20 @@ class PPOTrainer(BaseRLTrainer):
             in_shardings=(self.param_shardings,),
             out_shardings=self.param_shardings,
         )
+        # Async actor–learner weight push (trainer/async_rl.py): the
+        # refreshed behavior policy actors receive MID-generation. Same
+        # math as the phase-start snapshot — compute-dtype cast (when
+        # enabled) + unconditional per-leaf copy, and the copy is just
+        # as load-bearing here: the pushed tree must own every buffer it
+        # hands the engine, because the very next train step donates the
+        # masters it would otherwise alias. A separate jit instance so
+        # the analysis harness audits the push program the async path
+        # actually dispatches (subject ppo.async_weight_push).
+        self._weight_push_jit = jax.jit(
+            behavior_snapshot,
+            in_shardings=(self.param_shardings,),
+            out_shardings=self.param_shardings,
+        )
 
         self._score_ref_jit = jax.jit(
             self._ref_logprobs,
@@ -1044,6 +1099,45 @@ class PPOTrainer(BaseRLTrainer):
                 "use engine: fixed"
             )
 
+    def _validate_async_rl(self) -> None:
+        """``train.async_rl.enabled`` preconditions, checked at
+        construction so config errors are instant: the actors ARE the
+        continuous engine (whose own validation already refuses pp
+        meshes, grouped/GRPO sampling, and seq2seq)."""
+        if self.rollout_engine != "continuous":
+            raise ValueError(
+                "train.async_rl.enabled requires train.rollout.engine: "
+                "'continuous' — the asynchronous actors run the "
+                "slot-admission engine (docs/async_pipeline.md); add "
+                "rollout: {engine: continuous} or disable async_rl"
+            )
+        if not self.config.train.phase_overlap:
+            # the landing hook is the learner's whole consumption path;
+            # with overlap globally off the run would be silently serial
+            # while the user believes async is on — refuse loudly, like
+            # every other invalid async combination
+            raise ValueError(
+                "train.async_rl.enabled requires train.phase_overlap: "
+                "true (the streamed landing hook is how the async "
+                "learner consumes rollouts); drop phase_overlap: false "
+                "or disable async_rl"
+            )
+
+    def _to_actor(self, params):
+        """Reshard a learner-mesh param tree onto the actor device
+        subset (identity when actors share the trainer mesh). This is
+        the learner→actor transfer of the disaggregated layout — on
+        multi-host it becomes the ICI weight broadcast."""
+        if self._actor_param_shardings is None:
+            return params
+        return jax.device_put(params, self._actor_param_shardings)
+
+    def engine_start_params(self):
+        """Params the engine's phase starts on: the behavior snapshot
+        (or cast masters), resharded to the actor subset when one is
+        configured."""
+        return self._to_actor(self.rollout_params())
+
     def reset_rollout_phase(self) -> None:
         """Start a fresh rollout phase for per-row RNG: the next sampler
         or engine call derives a new phase key (ONE split of self.rng,
@@ -1099,6 +1193,60 @@ class PPOTrainer(BaseRLTrainer):
                 last_only=last_only,
             )
 
+        # actor device subset (async_rl.actor_fraction < 1): the engine
+        # lives on its own dp-only submesh; params reshard to it on
+        # every weight push and harvest groups reshard back at landing —
+        # the single-process rehearsal of multi-host actor/learner
+        # placement (ROADMAP direction 3). cache sp-sharding does not
+        # apply on the dp-only actor mesh.
+        engine_mesh = self.mesh
+        engine_shardings = self.param_shardings
+        cache_sharding = self._decode_cache_sharding()
+        admit_width = cfg.admit_width
+        harvest_width = cfg.harvest_width
+        if self.async_config.enabled and self.async_config.actor_fraction < 1:
+            from trlx_tpu.trainer.async_rl import actor_submesh
+
+            amesh = actor_submesh(self.mesh, self.async_config.actor_fraction)
+            if amesh is not None:
+                specs = make_partition_specs(
+                    self.state.params, amesh, self.partition_rules
+                )
+                ashardings = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(amesh, s),
+                    specs,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+                self._actor_mesh = amesh
+                self._actor_param_shardings = ashardings
+                engine_mesh, engine_shardings = amesh, ashardings
+                cache_sharding = None
+                # harvest groups cross from the actor submesh to the
+                # LEARNER mesh at landing (score_ref/rewards/store all
+                # run there), so the admit/harvest widths must divide
+                # over BOTH meshes' data shards — round them up to the
+                # lcm here (the engine itself only knows its own mesh)
+                import math
+
+                shape = dict(self.mesh.shape)
+                lshard = shape.get("dp", 1) * shape.get("fsdp", 1)
+                ashape = dict(amesh.shape)
+                ashard = ashape.get("dp", 1) * ashape.get("fsdp", 1)
+                mult = math.lcm(lshard, ashard)
+
+                def up(n: int) -> int:
+                    return ((n + mult - 1) // mult) * mult
+
+                admit_width = up(admit_width or max(1, num_slots // 4))
+                harvest_width = up(harvest_width or admit_width)
+                if harvest_width > num_slots:
+                    raise ValueError(
+                        f"async actor/learner meshes need harvest "
+                        f"groups of a multiple of {mult} rows, but "
+                        f"{harvest_width} exceeds the {num_slots}-slot "
+                        "pool; raise rollout.slots or actor_fraction"
+                    )
+
         return ContinuousBatchingEngine(
             apply_fn=apply_fn,
             init_cache_fn=functools.partial(
@@ -1108,12 +1256,13 @@ class PPOTrainer(BaseRLTrainer):
             query_length=self.query_length,
             vocab_size=self.model_config.vocab_size,
             num_slots=num_slots,
-            admit_width=cfg.admit_width,
-            harvest_width=cfg.harvest_width,
+            admit_width=admit_width,
+            harvest_width=harvest_width,
             block_size=cfg.block_size,
-            mesh=self.mesh,
-            param_shardings=self.param_shardings,
-            cache_sharding=self._decode_cache_sharding(),
+            done_poll_interval=cfg.poll_interval,
+            mesh=engine_mesh,
+            param_shardings=engine_shardings,
+            cache_sharding=cache_sharding,
             with_values=True,
         )
 
@@ -1271,7 +1420,16 @@ class PPOTrainer(BaseRLTrainer):
         # is row-comparable to the same phase collected fixed-batch
         self.reset_rollout_phase()
         self._behavior_params = self._behavior_snapshot_jit(self.state.params)
-        self._stream = _StreamedPhase(
+        # async actor–learner mode rides the streamed-phase machinery
+        # with version/guard/push state on top (trainer/async_rl.py);
+        # the explicit overlap=False escape (the serial parity baseline)
+        # still runs the plain serial schedule even under async config
+        phase_cls = (
+            _AsyncStreamedPhase
+            if self.async_config.enabled and overlap is not False
+            else _StreamedPhase
+        )
+        self._stream = phase_cls(
             plan,
             overlap=train.phase_overlap if overlap is None else bool(overlap),
         )
@@ -1289,10 +1447,40 @@ class PPOTrainer(BaseRLTrainer):
     def _dispatch_ready_minibatches(self, force: bool = False) -> None:
         st = self._stream
         plan = st.plan
+        is_async = isinstance(st, _AsyncStreamedPhase)
         landed = len(self.buffer)
         while st.next_mb < plan.n_minibatches and (
             force or plan.ready(st.next_mb, landed)
         ):
+            if is_async and not force:
+                # version-lag guard (trainer/async_rl.py::guard_allows):
+                # defer consumption whenever advancing the learner would
+                # push any in-flight rollout's staleness past the
+                # window. staleness_window=0 defers EVERYTHING while the
+                # actors work — the bitwise-serial degenerate mode.
+                from trlx_tpu.trainer.async_rl import guard_allows
+
+                engine = self._rollout_engine_obj
+                inflight = (
+                    engine.min_inflight_version()
+                    if engine is not None
+                    else None
+                )
+                if not guard_allows(
+                    st.learner_version,
+                    inflight,
+                    self.async_config.staleness_window,
+                ):
+                    # learner-idle attribution: rows are ready, the
+                    # guard is what's holding them
+                    if st.t_guard_hold is None:
+                        st.t_guard_hold = telemetry.monotonic()
+                    return
+            if is_async and st.t_guard_hold is not None:
+                st.guard_hold_ms += (
+                    telemetry.monotonic() - st.t_guard_hold
+                ) * 1000.0
+                st.t_guard_hold = None
             # one span per epoch-1 dispatch: during collection these nest
             # strictly inside the phase/collect span (via collect/land),
             # which is how the trace shows what overlapped with what;
@@ -1308,6 +1496,73 @@ class PPOTrainer(BaseRLTrainer):
                 st.t_first_dispatch = sp.start
             st.epoch1_stats.append(stats)
             st.next_mb += 1
+            if is_async:
+                self._after_async_update(st, plan, sp)
+
+    def _after_async_update(
+        self, st: "_AsyncStreamedPhase", plan: StreamPlan, sp
+    ) -> None:
+        """Async actor–learner bookkeeping after one consumed epoch-1
+        minibatch: record its staleness (learner version at consumption
+        minus the oldest behavior version among its rows), advance the
+        learner version, and — while the actors still have work in
+        flight — push the refreshed weights to the engine
+        mid-generation (the in-flight update; the engine applies it at
+        its harvest→admit safe point). No push once the actors are
+        drained OR collection is closed: it could change nothing this
+        plan consumes, and skipping it is what makes the
+        staleness_window=0 run bitwise-serial (zero pushes ⇒ rollouts
+        identical to the serial baseline — including when a
+        chunk-rounded over-submission leaves rows in flight at the
+        forced drain)."""
+        # consumption lag (PipelineRL's "how old is the data"): learner
+        # updates between a minibatch's oldest row being GENERATED and
+        # it being trained — read from the stream store's version
+        # column. Bounded by the plan (serial PPO has the same lag),
+        # reported for attribution, never guarded on.
+        consumed = plan.epoch1[st.next_mb - 1]
+        st.consumed_lag.append(
+            int(
+                st.learner_version
+                - int(self.buffer.row_versions(consumed).min())
+            )
+        )
+        st.learner_version += 1
+        st.learner_busy_ms += sp.duration_ms
+        if st.collect_done:
+            # post-collection (forced drain): nothing in flight can land
+            # into this plan — the bounded in-flight lag is vacuously 0
+            # and a push could only perturb the NEXT phase's snapshot
+            st.staleness.append(0)
+            return
+        engine = self._rollout_engine_obj
+        # the bounded quantity — in-flight generation lag AFTER this
+        # update: how many learner versions ahead of the oldest rollout
+        # still being generated the policy now is. The guard admitted
+        # this update, so the recorded value is <= staleness_window by
+        # construction; the staleness-breach detector watching the
+        # phase max is therefore a true invariant check, not a tuning
+        # knob. (Consumption lag — how many updates a LANDED row waits
+        # before epoch-1 trains it — is bounded by the plan itself and
+        # is not a staleness hazard: serial PPO has the same lag.)
+        inflight = (
+            engine.min_inflight_version() if engine is not None else None
+        )
+        st.staleness.append(
+            0 if inflight is None
+            else max(0, st.learner_version - int(inflight))
+        )
+        if engine is None or not engine.pending:
+            return
+        with telemetry.span(
+            "async/weight_push", force=True, version=st.learner_version
+        ) as push_sp:
+            pushed = self._weight_push_jit(self.state.params)
+            engine.push_weights(
+                self._to_actor(pushed), version=st.learner_version
+            )
+        st.weight_pushes += 1
+        st.learner_busy_ms += push_sp.duration_ms
 
     def finish_streamed_phase(
         self,
@@ -1332,6 +1587,8 @@ class PPOTrainer(BaseRLTrainer):
         # exp/overlap_* stats stay correct), they just go unrecorded.
         residual_stats = None
         residual_ms = 0.0
+        if isinstance(st, _AsyncStreamedPhase):
+            st.collect_done = True
         with telemetry.span(
             "phase/train", force=True, updates=plan.n_updates
         ) as train_sp:
@@ -1419,6 +1676,38 @@ class PPOTrainer(BaseRLTrainer):
 
         self._last_overlap_stats.update(phase_memory_stats())
 
+        # async actor–learner attribution (docs/async_pipeline.md):
+        # staleness distribution over consumed epoch-1 minibatches,
+        # learner idle (post-collect drain + time row-ready minibatches
+        # sat behind the version-lag guard), actor/learner occupancy,
+        # and the in-flight push count. async/staleness (the max) is
+        # the staleness-breach detector's series.
+        async_staleness_max: Optional[float] = None
+        if isinstance(st, _AsyncStreamedPhase):
+            st.learner_busy_ms += residual_ms
+            staleness = np.asarray(st.staleness or [0], np.float64)
+            lag = np.asarray(st.consumed_lag or [0], np.float64)
+            wall_ms = max(
+                (telemetry.monotonic() - st.t_begin) * 1000.0, 1e-9
+            )
+            async_staleness_max = float(staleness.max())
+            engine = self._rollout_engine_obj
+            self._last_overlap_stats.update({
+                "async/staleness_p50": float(np.percentile(staleness, 50)),
+                "async/staleness_max": async_staleness_max,
+                "async/consumed_lag_p50": float(np.percentile(lag, 50)),
+                "async/consumed_lag_max": float(lag.max()),
+                "async/weight_pushes": float(st.weight_pushes),
+                "async/guard_hold_ms": st.guard_hold_ms,
+                "async/learner_idle_ms": drain_ms + st.guard_hold_ms,
+                "async/learner_occupancy": min(
+                    st.learner_busy_ms / wall_ms, 1.0
+                ),
+                "async/actor_occupancy": (
+                    engine.stats.slot_util if engine is not None else 0.0
+                ),
+            })
+
         self._stream = None
 
         # run-health: feed every fetched update row to the detector
@@ -1434,13 +1723,18 @@ class PPOTrainer(BaseRLTrainer):
         if self.health_monitor is not None:
             phase_id = self.health_phase_id
             last_row: Dict[str, Any] = {}
+            phase_row: Dict[str, Any] = {
+                "policy/mean_rollout_kl": self._last_phase_mean_kl
+            }
+            if async_staleness_max is not None:
+                # the staleness-breach circuit-breaker's series: one
+                # observation per phase (kind "above" is always armed)
+                phase_row["async/staleness"] = async_staleness_max
             try:
                 last_row = self.observe_health_rows(
                     rows,
                     phase=phase_id,
-                    phase_row={
-                        "policy/mean_rollout_kl": self._last_phase_mean_kl
-                    },
+                    phase_row=phase_row,
                 )
             finally:
                 self.record_flight_phase(
