@@ -191,6 +191,11 @@ def main():
                      "AB_ASYNC_RL.json"),
         record,
     )
+    # run-ledger history next to the latest-per-key artifact, so any
+    # two async A/B rounds diff via `telemetry --compare`
+    from trlx_tpu.telemetry.run_ledger import append_ab_manifest
+
+    append_ab_manifest("ab_async_rl", record)
     return 0
 
 

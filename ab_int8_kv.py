@@ -204,6 +204,10 @@ def main():
                      "AB_INT8_KV.json"),
         record,
     )
+    # run-ledger history next to the latest-per-key artifact
+    from trlx_tpu.telemetry.run_ledger import append_ab_manifest
+
+    append_ab_manifest("ab_int8_kv", record)
 
 
 if __name__ == "__main__":

@@ -163,6 +163,10 @@ def main():
                      "AB_PHASE_OVERLAP.json"),
         record,
     )
+    # run-ledger history next to the latest-per-key artifact
+    from trlx_tpu.telemetry.run_ledger import append_ab_manifest
+
+    append_ab_manifest("ab_phase_overlap", record)
 
 
 if __name__ == "__main__":

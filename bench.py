@@ -46,23 +46,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 A100_BASELINE_SAMPLES_PER_SEC = 12.0
 
-# Published bf16 peak per chip by device_kind (dense, no sparsity).
-BF16_PEAK_TFLOPS = {
-    "TPU v3": 123.0,
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,  # v5e
-    "TPU v5": 459.0,  # v5p
-    "TPU v6 lite": 918.0,  # v6e (Trillium)
-}
+# BENCH payload schema: bump when a top-level key changes meaning, so
+# round-over-round diffs (and the run-ledger compare) are
+# machine-checkable against the layout they were written under.
+BENCH_SCHEMA_VERSION = 1
 
-# Published HBM bandwidth per chip (GB/s).
-HBM_PEAK_GBPS = {
-    "TPU v3": 900.0,
-    "TPU v4": 1228.0,
-    "TPU v5 lite": 819.0,  # v5e
-    "TPU v5": 2765.0,  # v5p
-    "TPU v6 lite": 1640.0,  # v6e
-}
+# Published per-chip peaks (bf16 TFLOP/s, HBM GB/s) by device_kind —
+# single source shared with the attribution layer
+# (telemetry/attribution.py), which adds documented NOMINAL fallbacks
+# for backends without a published spec.
+from trlx_tpu.telemetry.attribution import (  # noqa: E402
+    BF16_PEAK_TFLOPS,
+    HBM_PEAK_GBPS,
+)
 
 
 def _collect_bytes(d, V, L, Q, R, B, kv_cache_bytes=1, weight_bytes=2):
@@ -645,14 +641,25 @@ def measure_throughput(config, n_phases=5):
     # ring evictions skew the p50s above with no other signal — surface
     # the count in the payload and warn once on stderr when nonzero
     out["spans_dropped"] = telemetry.warn_on_span_drops(tracer)
+    # utilization attribution (telemetry/attribution.py,
+    # docs/observability.md): engine-7 statics ÷ the measured span walls
+    # above — measured MFU + HBM-BW util per traced program, the async
+    # bubble breakdown, and phase goodput. The table prints to stderr
+    # (stdout stays one JSON line); the payload carries the same rows.
+    out.update(
+        _attribution_payload(trainer, config, span_stats, n_phases, n_chips)
+    )
     # run-health summary (docs/observability.md): detector trip counts
     # over the measured window (a tripped kl-spike/entropy-collapse
     # means the throughput sample rode a diverging run) + the last
-    # observed training-dynamics scalars
-    monitor = getattr(trainer, "health_monitor", None)
-    if monitor is not None:
-        out["health_events"] = dict(sorted(monitor.event_counts.items()))
-        out["health"] = monitor.health_summary()
+    # observed training-dynamics scalars. NOTE: distinct name — the
+    # health block used to rebind `monitor` (the CompileMonitor), so
+    # every health-enabled bench run crashed at the compile-counts
+    # epilogue below with HealthMonitor.counts()
+    health_mon = getattr(trainer, "health_monitor", None)
+    if health_mon is not None:
+        out["health_events"] = dict(sorted(health_mon.event_counts.items()))
+        out["health"] = health_mon.health_summary()
     static_res = _static_resources(trainer)
     out.update(static_res)
     out.update(
@@ -682,7 +689,87 @@ def measure_throughput(config, n_phases=5):
         out["steady_compiles"] = dict(sorted(steady.items()))
     out["trace_seconds"] = round(monitor.trace_seconds, 1)
     out["compile_seconds"] = round(monitor.compile_seconds, 1)
+    # metrics snapshot for THIS workload's ledger manifest — the
+    # registry is process-global, so without capturing here the frozen
+    # secondary run would overwrite the gauges the faithful manifest
+    # reports; main() pops this before printing the JSON line
+    out["_metrics_snapshot"] = telemetry.get_metrics().snapshot()
     return out
+
+
+def _attribution_payload(trainer, config, span_stats, n_phases, n_chips):
+    """Measured-MFU ledger for the bench window (docs/observability.md,
+    "Utilization attribution"): engine-7 statics traced at the REAL
+    workload shape joined with the measured span walls. Prints the
+    "where did the time go" table + async bubble breakdown to stderr;
+    returns the machine-readable payload keys. Guarded — the headline
+    numbers must still print if any trace drifts."""
+    try:
+        import jax
+
+        from trlx_tpu.telemetry import attribution
+
+        method = config.method
+        n_mb = max(method.num_rollouts // config.train.batch_size, 1)
+        resources = attribution.trainer_program_resources(
+            trainer,
+            kind="ppo",
+            chunk_size=method.chunk_size,
+            residual_len=n_mb * max(method.ppo_epochs - 1, 0),
+        )
+        engine = (
+            "continuous"
+            if getattr(trainer, "rollout_engine", "fixed") == "continuous"
+            else "fixed"
+        )
+        counts = {}
+        if getattr(trainer, "_rollout_engine_obj", None) is not None:
+            # EngineStats resets every start_phase, so the counters
+            # cover the LAST measured phase only, while the span walls
+            # accumulate over all n_phases — scale to the whole window
+            # (identical workload per phase) or the count_key rows
+            # would understate utilization by n_phases x
+            counts.update(
+                {
+                    k: v * n_phases
+                    for k, v in trainer._rollout_engine_obj.stats.to_dict().items()
+                    if isinstance(v, (int, float))
+                    and k != "engine/slot_util"  # a ratio, not a counter
+                }
+            )
+        rows = attribution.attribute(
+            resources,
+            span_stats,
+            device_kind=jax.devices()[0].device_kind,
+            n_devices=n_chips,
+            work=attribution.default_work(engine),
+            counts=counts,
+        )
+        bubbles = attribution.bubble_breakdown(
+            span_stats,
+            getattr(trainer, "_last_overlap_stats", None),
+            phases=n_phases,
+        )
+        goodput = attribution.phase_goodput(
+            span_stats, method.num_rollouts, phases=n_phases
+        )
+        print(
+            attribution.format_attribution(rows, bubbles, goodput),
+            file=sys.stderr,
+        )
+        out = {
+            "attribution": [r.to_dict() for r in rows],
+            "bubbles": {
+                k: round(v, 4) for k, v in bubbles.items()
+            },
+        }
+        if "goodput_samples_per_sec" in goodput:
+            out["goodput_samples_per_sec"] = round(
+                goodput["goodput_samples_per_sec"], 3
+            )
+        return out
+    except Exception as e:  # the measured numbers must still print
+        return {"attribution_error": f"{type(e).__name__}: {e}"}
 
 
 def _static_resources(trainer):
@@ -754,6 +841,10 @@ def main():
     frozen = measure_throughput(_workload_config(2, None))
 
     extras = dict(faithful)
+    # the faithful (headline) workload's registry snapshot, for the
+    # ledger manifest — never part of the printed JSON line
+    metrics_snapshot = extras.pop("_metrics_snapshot", None)
+    frozen.pop("_metrics_snapshot", None)
     per_chip = extras.pop("value")
     extras["value_frozen_top2"] = frozen["value"]
     extras["vs_baseline_frozen_top2"] = round(
@@ -785,17 +876,41 @@ def main():
             f"{extras['reward_plateau_steps']} updates"
         )
 
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_samples_per_sec_per_chip_gpt2s",
-                "value": per_chip,
-                "unit": "samples/s/chip",
-                "vs_baseline": round(per_chip / A100_BASELINE_SAMPLES_PER_SEC, 3),
-                **extras,
-            }
+    record = {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "metric": "ppo_samples_per_sec_per_chip_gpt2s",
+        "value": per_chip,
+        "unit": "samples/s/chip",
+        "vs_baseline": round(per_chip / A100_BASELINE_SAMPLES_PER_SEC, 3),
+        **extras,
+    }
+    print(json.dumps(record))
+
+    # run ledger (telemetry/run_ledger.py): every bench round appends a
+    # manifest — config fingerprint, platform, git sha, the attribution
+    # table, and the full payload — so `python -m trlx_tpu.telemetry
+    # --compare` diffs rounds mechanically. Best-effort: the JSON line
+    # above is the contract output.
+    try:
+        from trlx_tpu.telemetry.run_ledger import (
+            append_manifest,
+            build_manifest,
+            numeric_payload,
         )
-    )
+
+        path = append_manifest(
+            build_manifest(
+                "bench",
+                payload=numeric_payload(record),
+                attribution=record.get("attribution") or [],
+                span_stats=record.get("spans") or {},
+                metrics=metrics_snapshot,
+            )
+        )
+        print(f"bench: run manifest appended to {path}", file=sys.stderr)
+    except Exception as e:
+        print(f"bench: ledger append failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

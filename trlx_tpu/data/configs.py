@@ -152,6 +152,15 @@ class TrainConfig:
     # at the END of exactly phase N, on demand — crash dumps need no flag;
     # requires health.enabled
     flight_dump_phase: Optional[int] = None
+    # Run ledger & live watching (telemetry/run_ledger.py,
+    # docs/observability.md "Run ledger"): with a directory set, the run
+    # mirrors each flight-recorder phase record into
+    # <run_dir>/phases.jsonl (the `python -m trlx_tpu.telemetry --watch
+    # <run_dir>` feed; live rows require health.enabled, which drives the
+    # phase records) and the learn() epilogue appends a RunManifest to
+    # <run_dir>/manifest.json plus the ledger JSONL ($TRLX_RUN_LEDGER, or
+    # <run_dir>/ledger.jsonl). Default off: nothing is written.
+    run_dir: Optional[str] = None
     # Fault tolerance (trlx_tpu/resilience, docs/resilience.md):
     # {"enabled": true, "max_restarts": 2, "resume_on_preemption": true,
     #  "preempt_signals": ["SIGTERM", "SIGINT"], "restart_delay_s": 0.0,

@@ -72,6 +72,11 @@ def serving_smoke(mesh=None, n_prompts: int = 6) -> int:
         "submitted": len(ids),
         "lengths": [results[r]["length"] for r in ids if r in results],
         "health_events": [ev.to_dict() for ev in events],
+        # per-request latency histograms (docs/observability.md,
+        # "Serving metrics"): queue wait / prefill / TTFT / per-token
+        # decode / e2e summaries — the CI job asserts these keys exist
+        # with nonzero counts in the JSON artifact
+        "serving_metrics": server.metrics(),
         **server.stats(),
     }
     print(json.dumps(record))
@@ -83,6 +88,37 @@ def serving_smoke(mesh=None, n_prompts: int = 6) -> int:
         print(f"serving-smoke FAIL: {len(events)} health events on a "
               "clean run", file=sys.stderr)
         return 1
+    from trlx_tpu import telemetry
+    from trlx_tpu.inference.server import SERVE_HISTOGRAMS
+
+    if telemetry.get_metrics().enabled:
+        missing = [
+            k for k in SERVE_HISTOGRAMS
+            if not record["serving_metrics"].get(k, {}).get("count")
+        ]
+        if missing:
+            print(f"serving-smoke FAIL: request-latency histograms "
+                  f"{missing} missing/empty", file=sys.stderr)
+            return 1
+    else:
+        # TRLX_TELEMETRY=0 (or non-rank-0): histograms are legitimately
+        # absent — telemetry off is the operator's choice, not a wiring
+        # regression; the completion/health gates above still hold
+        print("serving-smoke: metrics registry disabled — skipping "
+              "request-latency key check", file=sys.stderr)
+    # run-ledger recording (docs/observability.md "Run ledger"): with
+    # $TRLX_RUN_LEDGER set, each smoke appends a manifest — the CI
+    # perf-budget job records two and diffs them via --compare
+    if os.environ.get("TRLX_RUN_LEDGER"):
+        from trlx_tpu.telemetry.run_ledger import (
+            append_manifest,
+            build_manifest,
+            numeric_payload,
+        )
+
+        append_manifest(
+            build_manifest("serving-smoke", payload=numeric_payload(record))
+        )
     print("serving-smoke PASS: all requests completed, zero health events",
           file=sys.stderr)
     return 0

@@ -253,6 +253,12 @@ class ContinuousBatchingEngine:
         self._pending_version: Optional[int] = None
         self._steps_since_poll = 0
         self.stats = EngineStats(num_slots=self.num_slots)
+        # per-request latency bookkeeping (docs/observability.md,
+        # "Serving metrics"): submit/admit/prefill/complete marks on the
+        # shared telemetry clock, popped by the serving layer into its
+        # latency histograms. Host dispatch timing — on an accelerator
+        # the prefill mark is the dispatch wall, not device occupancy.
+        self._req_times: Dict[int, Dict[str, float]] = {}
 
     # ------------------------- jitted programs ------------------------- #
 
@@ -596,6 +602,7 @@ class ContinuousBatchingEngine:
         self._pending_version = None
         self._steps_since_poll = 0
         self.stats = EngineStats(num_slots=self.num_slots)
+        self._req_times = {}
 
     def push_weights(self, params, version: Optional[int] = None) -> None:
         """Stage a refreshed behavior policy for in-flight application
@@ -662,10 +669,12 @@ class ContinuousBatchingEngine:
                 f"submit expects [n, Q={self.Q}] prompt ids, got {ids.shape}"
             )
         rows = []
+        t_submit = telemetry.monotonic()
         for i in range(ids.shape[0]):
             row = self._next_row
             self._next_row += 1
             self._queue.append((ids[i], mask[i], row))
+            self._req_times[row] = {"submitted": t_submit}
             rows.append(row)
         return rows
 
@@ -673,6 +682,38 @@ class ContinuousBatchingEngine:
     def pending(self) -> int:
         """Rows submitted but not yet harvested."""
         return len(self._queue) + len(self._busy_rows) + len(self._done_slots)
+
+    def pop_request_timing(self, row: int) -> Optional[Dict[str, float]]:
+        """The per-request latency decomposition for a HARVESTED row,
+        in milliseconds — popped (each row reports once; un-popped rows
+        are cleared at the next ``start_phase``):
+
+        - ``queue_wait_ms``: submit → admission (slot-pool wait),
+        - ``prefill_ms``: admission → first-token mark (the prefill
+          dispatch that produces the row's first token),
+        - ``ttft_ms``: submit → first token,
+        - ``decode_ms``: first token → harvest,
+        - ``e2e_ms``: submit → harvest.
+
+        ``None`` for unknown/unfinished rows. Host dispatch timing on
+        the shared telemetry clock; the serving layer divides
+        ``decode_ms`` by the row's token count for per-token decode."""
+        marks = self._req_times.get(row)
+        if not marks or "completed" not in marks:
+            return None
+        self._req_times.pop(row, None)
+        submitted = marks["submitted"]
+        admitted = marks.get("admitted", submitted)
+        first = marks.get("first_token", admitted)
+        completed = marks["completed"]
+        ms = 1000.0
+        return {
+            "queue_wait_ms": max(0.0, (admitted - submitted) * ms),
+            "prefill_ms": max(0.0, (first - admitted) * ms),
+            "ttft_ms": max(0.0, (first - submitted) * ms),
+            "decode_ms": max(0.0, (completed - first) * ms),
+            "e2e_ms": max(0.0, (completed - submitted) * ms),
+        }
 
     def _admit(self) -> None:
         """Refill free slots from the queue, one padded prefill call per
@@ -705,6 +746,7 @@ class ContinuousBatchingEngine:
                     from trlx_tpu.parallel.mesh import batch_sharding
 
                     args = jax.device_put(args, batch_sharding(self.mesh))
+            t_admit = telemetry.monotonic()
             with telemetry.span(
                 "collect/prefill", force=True, admitted=take
             ):
@@ -718,6 +760,14 @@ class ContinuousBatchingEngine:
                     jnp.asarray(turns),
                     self._phase_key,
                 )
+            # prefill computes the group's FIRST tokens, so its dispatch
+            # end is the host-side time-to-first-token mark
+            t_first = telemetry.monotonic()
+            for _, _, row in entries:
+                marks = self._req_times.get(row)
+                if marks is not None:
+                    marks["admitted"] = t_admit
+                    marks["first_token"] = t_first
             self.stats.prefills += 1
             self.stats.admitted += take
 
@@ -735,6 +785,11 @@ class ContinuousBatchingEngine:
                 )
             rows = [self._busy_rows.pop(s) for s in slots]
             versions = [int(self._slot_versions[s]) for s in slots]
+            t_done = telemetry.monotonic()
+            for r in rows:
+                marks = self._req_times.get(r)
+                if marks is not None:
+                    marks["completed"] = t_done
             for s in slots:
                 self._recycle_counts[s] += 1
                 self._free.append(s)
@@ -805,6 +860,12 @@ class ContinuousBatchingEngine:
             self._steps_since_poll = 0
             done_host = np.asarray(jax.device_get(done))
             self.stats.done_polls += 1
+            # occupancy timeseries: one gauge sample per paid done-poll
+            # (the registry's ring is bounded; one host call per poll)
+            # — the Perfetto counter track rides these samples
+            telemetry.get_metrics().gauge("engine/slot_util").set(
+                self.stats.slot_util
+            )
             for slot, row in list(self._busy_rows.items()):
                 if done_host[slot] and slot not in self._done_slots:
                     self._done_slots.append(slot)

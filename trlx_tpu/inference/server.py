@@ -37,6 +37,39 @@ import numpy as np
 
 from trlx_tpu.data.configs import TRLConfig
 
+#: the per-request latency histograms every served request feeds
+#: (docs/observability.md "Serving metrics") — the substrate QoS
+#: scheduling will gate on; the CI serving-smoke asserts these keys
+SERVE_HISTOGRAMS = (
+    "serve/queue_wait_ms",
+    "serve/prefill_ms",
+    "serve/ttft_ms",
+    "serve/decode_per_token_ms",
+    "serve/e2e_ms",
+)
+
+
+def observe_request_metrics(
+    registry, timing: Dict[str, float], tokens: int
+) -> None:
+    """Feed one completed request's engine timing decomposition
+    (:meth:`~trlx_tpu.inference.engine.ContinuousBatchingEngine.
+    pop_request_timing`) into the latency histograms: queue wait,
+    prefill, time-to-first-token, per-token decode (``decode_ms`` over
+    the generated token count), end-to-end."""
+    registry.histogram("serve/queue_wait_ms").observe(
+        timing.get("queue_wait_ms", 0.0)
+    )
+    registry.histogram("serve/prefill_ms").observe(
+        timing.get("prefill_ms", 0.0)
+    )
+    registry.histogram("serve/ttft_ms").observe(timing.get("ttft_ms", 0.0))
+    registry.histogram("serve/decode_per_token_ms").observe(
+        timing.get("decode_ms", 0.0) / max(1, int(tokens))
+    )
+    registry.histogram("serve/e2e_ms").observe(timing.get("e2e_ms", 0.0))
+    registry.counter("serve/requests_completed").inc()
+
 
 class InferenceServer:
     """Submit/poll batched generation against a loaded policy.
@@ -291,14 +324,23 @@ class InferenceServer:
             pad_rows = []
         pad_set = set(pad_rows)
         completed = 0
+        from trlx_tpu import telemetry
+
+        registry = telemetry.get_metrics()
         for group in engine.drive(target):
             toks = np.asarray(jax.device_get(group["tokens"]))
             mask = np.asarray(jax.device_get(group["response_mask"]))
             self._observe_group(group)
             for j, r in enumerate(group["rows"]):
+                timing = engine.pop_request_timing(r)
                 if r in pad_set or r not in self._open:
                     continue
                 length = int(mask[j].sum())
+                # per-request latency histograms through the shared
+                # metrics registry (queue wait, prefill, TTFT,
+                # per-token decode, e2e) — docs/observability.md
+                if timing is not None:
+                    observe_request_metrics(registry, timing, length)
                 out: Dict[str, Any] = {
                     "tokens": toks[j, :length].tolist(),
                     "length": length,
@@ -343,3 +385,20 @@ class InferenceServer:
     def stats(self) -> Dict[str, float]:
         """Engine occupancy/throughput counters (cumulative this phase)."""
         return self.engine.stats.to_dict()
+
+    def metrics(self) -> Dict[str, Any]:
+        """The ``serve/*`` slice of the metrics-registry snapshot: the
+        per-request latency histograms (summaries) and counters this
+        process accumulated."""
+        from trlx_tpu import telemetry
+
+        snap = telemetry.get_metrics().snapshot()
+        out: Dict[str, Any] = {}
+        for section in ("counters", "gauges"):
+            for name, value in snap.get(section, {}).items():
+                if name.startswith("serve/"):
+                    out[name] = value
+        for name, summary in snap.get("histograms", {}).items():
+            if name.startswith("serve/"):
+                out[name] = summary
+        return out

@@ -429,6 +429,12 @@ class PPOOrchestrator(Orchestrator):
         }
         if extra:
             stats.update(extra)
+        # unified metrics namespace (telemetry/metrics.py): the collect
+        # row's host-float stats — engine/* occupancy included via
+        # `extra` on the continuous path — become registry gauges, so
+        # the ledger/flight/bench snapshots see them without knowing
+        # this dict's shape
+        telemetry.get_metrics().absorb(stats)
         # run-health: the collect stats row feeds the detectors too —
         # exp/score_std is the reward-saturation series. Host floats
         # only; the device-resident mean_rollout_kl scalar is skipped by

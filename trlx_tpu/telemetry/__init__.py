@@ -23,6 +23,19 @@ cost before a run; this package watches the run itself:
   exceptions / detector policy / ``train.flight_dump_phase``;
   ``python -m trlx_tpu.telemetry --inspect <dump>`` renders the
   triage view.
+- :mod:`trlx_tpu.telemetry.metrics` — typed rank-0 metrics registry
+  (counters, gauges with sample rings, histograms) absorbing the
+  ad-hoc stats dicts (``engine/*``, ``async/*``, ``mem/*``,
+  ``serve/*``) into one snapshot-able namespace;
+  ``telemetry.get_metrics()``.
+- :mod:`trlx_tpu.telemetry.attribution` — measured MFU / HBM-BW
+  utilization per traced program per phase window (engine-7 statics ÷
+  span walls), async bubble breakdown, phase goodput — bench prints
+  the table every round.
+- :mod:`trlx_tpu.telemetry.run_ledger` — per-run manifests appended to
+  a ledger JSONL; ``python -m trlx_tpu.telemetry --compare`` renders a
+  movers diff between any two runs, ``--watch`` tails a live run's
+  phase rows.
 
 Engine 10 (``python -m trlx_tpu.analysis --perf-audit``) gates the
 span durations against the ``perf_budgets`` section of
@@ -44,25 +57,41 @@ from trlx_tpu.telemetry.tracer import (  # noqa: F401
     NULL_SPAN,
     Span,
     Tracer,
+    chrome_counter_events,
     chrome_trace_events,
     chrome_trace_from_jsonl,
     export_chrome_jsonl,
     monotonic,
     quantile,
 )
+from trlx_tpu.telemetry.metrics import (  # noqa: F401  (after tracer: shares its clock)
+    NULL_INSTRUMENT,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    configure_metrics,
+    flatten_snapshot,
+    get_metrics,
+    scoped_metrics,
+)
 
 __all__ = [
     "NULL_SPAN",
     "Span",
     "Tracer",
+    "chrome_counter_events",
     "chrome_trace_events",
     "chrome_trace_from_jsonl",
     "configure",
+    "configure_metrics",
     "export_chrome_jsonl",
+    "get_metrics",
     "get_tracer",
     "monotonic",
     "now",
     "quantile",
+    "scoped_metrics",
     "scoped_tracer",
     "span",
     "warn_on_span_drops",

@@ -1,13 +1,24 @@
-"""CLI: ``python -m trlx_tpu.telemetry --inspect <dump.json>``.
+"""CLI: flight-dump triage, run-ledger comparison, live run watching.
 
-Renders a flight-recorder forensics dump (docs/observability.md,
-"Flight recorder") as the human triage view: run header + error, the
-tripped-detector table, the last-good-phase stats diff, and span p50
-deltas. ``--json`` re-emits a machine-readable summary instead.
+Three subtools behind one entry point (docs/observability.md):
 
-Exit status: 0 on a parseable dump, 2 on an unreadable/incompatible
-file. (The dump's *content* never affects the exit code — this is a
-viewer, not a gate.)
+- ``python -m trlx_tpu.telemetry --inspect <dump.json>`` — render a
+  flight-recorder forensics dump as the human triage view: run header +
+  error, the tripped-detector table, the last-good-phase stats diff,
+  span p50 deltas, and the final phase's metrics snapshot. ``--json``
+  re-emits a machine-readable summary instead.
+- ``python -m trlx_tpu.telemetry --compare <run_a> <run_b>`` — resolve
+  two run-ledger manifests (run_id, ledger index like ``-1``, or a
+  manifest file path; ``--ledger`` overrides ``$TRLX_RUN_LEDGER``) and
+  render the regression diff: movers by relative delta, span p50s,
+  attribution MFU per program.
+- ``python -m trlx_tpu.telemetry --watch <run_dir>`` — tail the live
+  ``phases.jsonl`` a ``train.run_dir`` run mirrors its phase records
+  into, one line per phase (``--no-follow`` renders what exists and
+  exits — the CI/test mode).
+
+Exit status: 0 on success, 2 on unreadable/unresolvable inputs. (The
+content never affects the exit code — these are viewers, not gates.)
 """
 
 from __future__ import annotations
@@ -20,13 +31,41 @@ import sys
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m trlx_tpu.telemetry",
-        description="inspect run-health flight-recorder dumps",
+        description=(
+            "inspect flight dumps, compare run-ledger manifests, watch "
+            "live runs"
+        ),
     )
     parser.add_argument(
         "--inspect",
         metavar="DUMP",
-        required=True,
         help="path to a flight-recorder JSON dump",
+    )
+    parser.add_argument(
+        "--compare",
+        nargs=2,
+        metavar=("RUN_A", "RUN_B"),
+        help=(
+            "two runs to diff: run_id, ledger index (-1 newest), or a "
+            "manifest JSON path"
+        ),
+    )
+    parser.add_argument(
+        "--ledger",
+        metavar="PATH",
+        default=None,
+        help="ledger JSONL for --compare run resolution "
+        "(default: $TRLX_RUN_LEDGER or RUN_LEDGER.jsonl)",
+    )
+    parser.add_argument(
+        "--watch",
+        metavar="RUN_DIR",
+        help="tail a run's live phases.jsonl (a train.run_dir)",
+    )
+    parser.add_argument(
+        "--no-follow",
+        action="store_true",
+        help="with --watch: render the rows on disk and exit",
     )
     parser.add_argument(
         "--json",
@@ -35,34 +74,79 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
-    from trlx_tpu.telemetry.flight_recorder import inspect_dump, load_dump
+    if args.compare:
+        from trlx_tpu.telemetry.run_ledger import compare_runs, resolve_run
 
-    try:
-        payload = load_dump(args.inspect)
-    except (OSError, ValueError, json.JSONDecodeError) as e:
-        print(f"error: cannot read {args.inspect}: {e}", file=sys.stderr)
-        return 2
+        try:
+            run_a = resolve_run(args.compare[0], args.ledger)
+            run_b = resolve_run(args.compare[1], args.ledger)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            from trlx_tpu.telemetry.run_ledger import flatten_numeric
 
-    if args.json:
-        events = payload.get("events") or []
-        counts: dict = {}
-        for e in events:
-            det = e.get("detector", "?")
-            counts[det] = counts.get(det, 0) + 1
-        print(
-            json.dumps(
-                {
-                    "reason": payload.get("reason"),
-                    "fingerprint": payload.get("fingerprint"),
-                    "error": payload.get("error"),
-                    "phases_recorded": len(payload.get("phases") or []),
-                    "event_counts": counts,
-                }
+            flat_a, flat_b = flatten_numeric(run_a), flatten_numeric(run_b)
+            deltas = {
+                k: {"a": flat_a[k], "b": flat_b[k]}
+                for k in sorted(set(flat_a) & set(flat_b))
+                if flat_a[k] != flat_b[k]
+            }
+            print(
+                json.dumps(
+                    {
+                        "run_a": run_a.get("run_id"),
+                        "run_b": run_b.get("run_id"),
+                        "deltas": deltas,
+                    }
+                )
             )
-        )
-    else:
-        print(inspect_dump(payload))
-    return 0
+        else:
+            print(compare_runs(run_a, run_b))
+        return 0
+
+    if args.watch:
+        from trlx_tpu.telemetry.run_ledger import watch
+
+        try:
+            watch(args.watch, follow=not args.no_follow)
+        except FileNotFoundError as e:
+            print(f"error: no phase log at {e}", file=sys.stderr)
+            return 2
+        return 0
+
+    if args.inspect:
+        from trlx_tpu.telemetry.flight_recorder import inspect_dump, load_dump
+
+        try:
+            payload = load_dump(args.inspect)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot read {args.inspect}: {e}", file=sys.stderr)
+            return 2
+
+        if args.json:
+            events = payload.get("events") or []
+            counts: dict = {}
+            for e in events:
+                det = e.get("detector", "?")
+                counts[det] = counts.get(det, 0) + 1
+            print(
+                json.dumps(
+                    {
+                        "reason": payload.get("reason"),
+                        "fingerprint": payload.get("fingerprint"),
+                        "error": payload.get("error"),
+                        "phases_recorded": len(payload.get("phases") or []),
+                        "event_counts": counts,
+                    }
+                )
+            )
+        else:
+            print(inspect_dump(payload))
+        return 0
+
+    parser.print_help()
+    return 2
 
 
 if __name__ == "__main__":

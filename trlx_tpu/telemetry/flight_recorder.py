@@ -79,6 +79,19 @@ def _memory_snapshot() -> Dict[str, int]:
         return {}
 
 
+def _metrics_snapshot() -> Dict[str, Any]:
+    """The metrics-registry namespace at this phase boundary (counters,
+    gauges, histogram summaries) — empty when the registry is disabled
+    or telemetry import fails (forensics must never add a failure
+    mode)."""
+    try:
+        from trlx_tpu import telemetry
+
+        return telemetry.get_metrics().snapshot()
+    except Exception:
+        return {}
+
+
 class FlightRecorder:
     """Bounded ring of phase records + the dump that ships them.
 
@@ -150,6 +163,7 @@ class FlightRecorder:
             "kl_seq": [float(k) for k in (kl_seq or [])],
             "spans": spans,
             "memory": _memory_snapshot(),
+            "metrics": _metrics_snapshot(),
             "events": event_dicts,
             "detectors": detector_state or {},
             "good": not has_error,
@@ -345,6 +359,32 @@ def inspect_dump(payload: Dict[str, Any]) -> str:
     else:
         lines.append("")
         lines.append("tripped detectors: none")
+
+    # metrics-registry snapshot of the final recorded phase: the
+    # unified namespace (engine occupancy, async bubbles, mem gauges,
+    # serving histograms) the run held when it went down
+    final_metrics = (phases[-1].get("metrics") or {}) if phases else {}
+    flat_metrics: List[tuple] = []
+    for name, value in (final_metrics.get("counters") or {}).items():
+        flat_metrics.append((name, _fmt(float(value))))
+    for name, value in (final_metrics.get("gauges") or {}).items():
+        flat_metrics.append((name, _fmt(float(value))))
+    for name, summary in (final_metrics.get("histograms") or {}).items():
+        if summary.get("count"):
+            flat_metrics.append(
+                (
+                    name,
+                    f"p50={_fmt(float(summary.get('p50', 0.0)))} "
+                    f"n={int(summary['count'])}",
+                )
+            )
+    if flat_metrics:
+        lines.append("")
+        lines.append("metrics snapshot (final phase):")
+        for name, rendered in sorted(flat_metrics)[:16]:
+            lines.append(f"  {name:32} {rendered:>16}")
+        if len(flat_metrics) > 16:
+            lines.append(f"  ... {len(flat_metrics) - 16} more")
 
     # last-good vs final phase
     final = phases[-1] if phases else None

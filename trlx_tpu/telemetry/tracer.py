@@ -318,7 +318,38 @@ def chrome_trace_events(spans: Iterable[Span]) -> List[Dict[str, Any]]:
     return meta + complete
 
 
-def export_chrome_jsonl(path: str, spans: Iterable[Span], writer=None) -> int:
+def chrome_counter_events(
+    series: Dict[str, Sequence[tuple]],
+) -> List[Dict[str, Any]]:
+    """Gauge timeseries as chrome-tracing counter-track (``ph: C``)
+    events, so memory/occupancy ride alongside the span tracks in one
+    Perfetto timeline: ``series`` maps a counter name (``mem/hbm_live``,
+    ``engine/slot_util``) to ``(t, value)`` samples on the shared
+    monotonic timebase — exactly what
+    :meth:`~trlx_tpu.telemetry.metrics.MetricsRegistry.gauge_series`
+    returns. Perfetto draws each name as its own stepped area chart."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    for name in sorted(series):
+        for t, value in series[name]:
+            events.append(
+                {
+                    "name": name,
+                    "ph": "C",
+                    "ts": round(t * 1e6, 3),
+                    "pid": pid,
+                    "args": {"value": value},
+                }
+            )
+    return events
+
+
+def export_chrome_jsonl(
+    path: str,
+    spans: Iterable[Span],
+    writer=None,
+    counters: Optional[Dict[str, Sequence[tuple]]] = None,
+) -> int:
     """Append the span stream to ``path`` as JSONL (one trace event per
     line). Returns the number of events written.
 
@@ -329,8 +360,15 @@ def export_chrome_jsonl(path: str, spans: Iterable[Span], writer=None) -> int:
     thread just to join it would be the same blocking with extra cost)
     — fine for end-of-run exports, not for per-phase hot paths. Load
     in Perfetto/chrome via :func:`chrome_trace_from_jsonl` (the array
-    wrapper)."""
+    wrapper).
+
+    ``counters`` adds counter-track events (gauge timeseries — see
+    :func:`chrome_counter_events`) to the same file; they share the
+    span events' timebase, so a ``mem/hbm_live`` step lines up under
+    the phase span that caused it."""
     events = chrome_trace_events(spans)
+    if counters:
+        events += chrome_counter_events(counters)
     if not events:
         return 0
     if writer is not None:

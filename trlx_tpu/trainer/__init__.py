@@ -100,6 +100,7 @@ class BaseRLTrainer(ABC):
         self._health_ev = True  # GRPO opts out (placeholder returns slot)
         self.health_monitor = None
         self.flight_recorder = None
+        self._phase_log = None  # run_dir live --watch feed (run_ledger.py)
         if not self._health_enabled:
             return
         from trlx_tpu.parallel.distributed import is_main_process
@@ -121,6 +122,14 @@ class BaseRLTrainer(ABC):
             fingerprint=fingerprint,
             config=config_dict,
         )
+        # live phase-row mirror for `--watch` (run_ledger.py): rides the
+        # flight recorder's phase records, so it shares its gating
+        # (health.enabled + rank 0)
+        run_dir = getattr(self.config.train, "run_dir", None)
+        if run_dir:
+            from trlx_tpu.telemetry.run_ledger import PhaseLogWriter
+
+            self._phase_log = PhaseLogWriter(run_dir)
 
     def observe_health(
         self,
@@ -346,7 +355,7 @@ class BaseRLTrainer(ABC):
         if recorder is None:
             return
         monitor = self.health_monitor
-        recorder.record_phase(
+        rec = recorder.record_phase(
             phase,
             step=step,
             stats_row=stats_row,
@@ -354,6 +363,12 @@ class BaseRLTrainer(ABC):
             events=monitor.recent_events(phase) if monitor else (),
             detector_state=monitor.state_summary() if monitor else None,
         )
+        if self._phase_log is not None:
+            # the live --watch feed: the same record, minus the
+            # detector EWMA state (bulky and meaningless line-by-line)
+            self._phase_log.append(
+                {k: v for k, v in rec.items() if k != "detectors"}
+            )
         want = self.config.train.flight_dump_phase
         if want is not None and phase == want:
             path = recorder.dump(f"flight_dump_phase:{phase}", once=True)
@@ -386,6 +401,75 @@ class BaseRLTrainer(ABC):
             return  # forensics must never mask the real failure
         if path:
             print(f"health: flight record dumped to {path}", file=sys.stderr)
+
+    def append_run_ledger(
+        self,
+        status: str = "ok",
+        error: Optional[BaseException] = None,
+        payload: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """learn()-epilogue hook (docs/observability.md "Run ledger"):
+        append this run's :class:`RunManifest` — config fingerprint,
+        platform, git sha, span stats, metrics snapshot, health-event
+        counts, final stats — to the ledger JSONL, and write
+        ``<run_dir>/manifest.json`` when ``train.run_dir`` is set.
+        Active only when ``train.run_dir`` or ``$TRLX_RUN_LEDGER`` is
+        configured; best-effort (a full disk must never mask the run's
+        real outcome)."""
+        import os
+
+        run_dir = getattr(self.config.train, "run_dir", None)
+        ledger_env = os.environ.get("TRLX_RUN_LEDGER")
+        if not run_dir and not ledger_env:
+            return
+        try:
+            from trlx_tpu.parallel.distributed import is_main_process
+
+            if not is_main_process():
+                return
+            import json
+
+            from trlx_tpu.telemetry.run_ledger import (
+                append_manifest,
+                build_manifest,
+                numeric_payload,
+            )
+
+            body = dict(payload or {})
+            body["status"] = status
+            if error is not None:
+                body["error"] = f"{type(error).__name__}: {error}"
+            body.update(
+                numeric_payload(getattr(self, "_final_stats", None) or {})
+            )
+            monitor = self.health_monitor
+            manifest = build_manifest(
+                kind=f"train/{type(self).__name__}",
+                config=self.config.to_dict(),
+                payload=body,
+                health_events=(
+                    dict(monitor.event_counts) if monitor is not None else {}
+                ),
+            )
+            ledger = ledger_env or (
+                os.path.join(run_dir, "ledger.jsonl") if run_dir else None
+            )
+            if ledger:
+                append_manifest(manifest, ledger)
+            if run_dir:
+                os.makedirs(run_dir, exist_ok=True)
+                with open(
+                    os.path.join(run_dir, "manifest.json"),
+                    "w",
+                    encoding="utf-8",
+                ) as fh:
+                    json.dump(manifest, fh, default=float)
+        except Exception as e:
+            print(
+                f"run_ledger: manifest append failed "
+                f"({type(e).__name__}: {e})",
+                file=sys.stderr,
+            )
 
     def add_eval_pipeline(self, pipeline) -> None:
         """Eval prompts source (reference `accelerate_base_model.py:148-150`)."""

@@ -530,12 +530,18 @@ class ILQLTrainer(BaseRLTrainer):
         )
         self.logger = logger
         try:
-            return self._learn_body(logger, total_steps, n_minibatches)
+            result = self._learn_body(logger, total_steps, n_minibatches)
         except BaseException as e:
             # crash forensics (telemetry/flight_recorder.py): no-op when
             # health is off, at most one dump per run
             self.flight_dump_on_exception(e)
+            # run ledger (telemetry/run_ledger.py): the failed-run
+            # manifest records the error outcome
+            self.append_run_ledger(status="error", error=e)
             raise
+        else:
+            self.append_run_ledger(status="ok")
+            return result
         finally:
             # single epilogue for every exit (incl. exceptions): join
             # in-flight async checkpoint writes, close the logger even if

@@ -1710,6 +1710,12 @@ class PPOTrainer(BaseRLTrainer):
 
         self._stream = None
 
+        # unified metrics namespace: the phase's overlap/async/memory
+        # attribution stats become registry gauges (async/guard_hold_ms,
+        # async/learner_idle_ms, mem/hbm_* — the bubble-breakdown
+        # inputs), snapshot-able by the ledger/flight recorder/bench
+        telemetry.get_metrics().absorb(self._last_overlap_stats)
+
         # run-health: feed every fetched update row to the detector
         # engine in execution order, the phase-level rollout KL (the
         # kl-spike series) once per phase, then append the phase's
@@ -1886,13 +1892,21 @@ class PPOTrainer(BaseRLTrainer):
         self.logger = logger
         self._profiling = False
         try:
-            return self._learn_body(logger, total_steps, n_minibatches, start_step)
+            result = self._learn_body(
+                logger, total_steps, n_minibatches, start_step
+            )
         except BaseException as e:
             # crash forensics: one flight dump per run on the way down
             # (telemetry/flight_recorder.py; no-op when health is off,
             # deduped when a HealthAbort's detector already dumped)
             self.flight_dump_on_exception(e)
+            # run ledger (telemetry/run_ledger.py): failed runs are
+            # history too — the manifest records the error outcome
+            self.append_run_ledger(status="error", error=e)
             raise
+        else:
+            self.append_run_ledger(status="ok")
+            return result
         finally:
             # single epilogue for every exit (incl. exceptions): stop any
             # live profiler trace (legacy first-steps AND the single-phase
