@@ -680,6 +680,140 @@ def _trace_engine_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram
             ),
             def_site=callable_def_site(engine.refill_jit),
         ),
+    ] + _trace_serving_engine_programs(trainer, engine, kind, mesh_shape)
+
+
+def _trace_serving_engine_programs(
+    trainer, engine, kind: str, mesh_shape
+) -> List[TracedProgram]:
+    """Trace the SERVING-tier engine variant (``trlx_tpu/serving``,
+    docs/serving.md): the same engine built with a shared-prefix pool
+    (``prefix_pool_blocks > 0`` — the cache layers carry the
+    replicated ``shared_k/v`` pool plus share/publish tables, and
+    prefill takes the per-row sharing maps) and streaming taps
+    (``decode_step`` additionally returns this step's (token, live)
+    emissions), plus the placeholder ``release`` program. The trainer
+    collect path never builds this variant — its three programs above
+    stay byte-identical — so these four are separate subjects with
+    their own resource-budget entries.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.inference.engine import ContinuousBatchingEngine
+    from trlx_tpu.parallel.mesh import batch_sharding
+
+    serving_engine = ContinuousBatchingEngine(
+        apply_fn=engine._apply_fn,
+        init_cache_fn=engine._init_cache_fn,
+        gen_config=engine.gen_config,
+        query_length=engine.Q,
+        vocab_size=engine.vocab_size,
+        num_slots=engine.num_slots,
+        admit_width=engine.admit_width,
+        harvest_width=engine.harvest_width,
+        block_size=engine.block_size,
+        mesh=engine.mesh,
+        param_shardings=engine._param_shardings,
+        cache_sharding=engine._cache_sharding,
+        with_values=engine.with_values,
+        prefix_pool_blocks=max(2, engine.Q // engine.block_size),
+        stream_taps=True,
+    )
+    axes = set(trainer.mesh.axis_names)
+    state_sds = jax.eval_shape(serving_engine._make_state)
+    params_sds = _sds(trainer.state.params)
+    A, C, Q = (
+        serving_engine.admit_width,
+        serving_engine.harvest_width,
+        serving_engine.Q,
+    )
+    nb = serving_engine.n_blocks
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+    key_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    state_sh = serving_engine.state_sharding()
+    batch_sh = batch_sharding(trainer.mesh)
+    params_sh = trainer.state_shardings.params
+    n_state = len(jax.tree_util.tree_leaves(state_sds))
+
+    prefill_args = (
+        params_sds, state_sds, i32(A), i32(A, Q), i32(A, Q), i32(A),
+        i32(A), key_sds, i32(A, nb), i32(A, nb),
+    )
+    prefill_prefixes = (
+        "params", "state", "slots", "prompt_ids", "prompt_mask",
+        "rows", "turns", "phase_key", "shared_map", "publish_map",
+    )
+    prefill_shardings = (
+        params_sh, state_sh, None, batch_sh, batch_sh, None, None,
+        None, None, None,
+    )
+    decode_args = (params_sds, state_sds)
+    refill_args = (state_sds, i32(C))
+    release_args = (state_sds, i32(A))
+    return [
+        TracedProgram(
+            subject=f"{kind}.engine_prefill_shared",
+            closed_jaxpr=jax.make_jaxpr(serving_engine.prefill_jit)(
+                *prefill_args
+            ),
+            mesh_axes=axes,
+            input_paths=flat_input_paths(
+                *prefill_args, prefixes=prefill_prefixes
+            ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                prefill_args, prefill_shardings
+            ),
+            def_site=callable_def_site(serving_engine.prefill_jit),
+        ),
+        TracedProgram(
+            subject=f"{kind}.engine_decode_step_stream",
+            closed_jaxpr=jax.make_jaxpr(serving_engine.decode_step_jit)(
+                *decode_args
+            ),
+            mesh_axes=axes,
+            input_paths=flat_input_paths(
+                *decode_args, prefixes=("params", "state")
+            ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                decode_args, (params_sh, state_sh)
+            ),
+            def_site=callable_def_site(serving_engine.decode_step_jit),
+        ),
+        TracedProgram(
+            subject=f"{kind}.engine_refill_shared",
+            closed_jaxpr=jax.make_jaxpr(serving_engine.refill_jit)(
+                *refill_args
+            ),
+            mesh_axes=axes,
+            n_donated_state_leaves=n_state,
+            input_paths=flat_input_paths(
+                *refill_args, prefixes=("state", "slots")
+            ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                refill_args, (state_sh, None)
+            ),
+            def_site=callable_def_site(serving_engine.refill_jit),
+        ),
+        TracedProgram(
+            subject=f"{kind}.engine_release",
+            closed_jaxpr=jax.make_jaxpr(serving_engine.release_jit)(
+                *release_args
+            ),
+            mesh_axes=axes,
+            n_donated_state_leaves=n_state,
+            input_paths=flat_input_paths(
+                *release_args, prefixes=("state", "slots")
+            ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                release_args, (state_sh, None)
+            ),
+            def_site=callable_def_site(serving_engine.release_jit),
+        ),
     ]
 
 

@@ -238,6 +238,14 @@ class TrainConfig:
     # (no pp mesh axis, no grouped/GRPO sampling yet); "fixed" is the
     # default and the parity baseline.
     rollout: Dict[str, Any] = field(default_factory=dict)
+    # Multi-tenant serving tier (trlx_tpu/serving, docs/serving.md),
+    # parsed into trlx_tpu.serving.ServingConfig and consumed by
+    # InferenceServer only (training ignores it): {"tenants": {...},
+    # "slo_classes": {...}, "prefix_cache_blocks": N, "stream_buffer": N,
+    # "aging_half_ms": ...}. prefix_cache_blocks > 0 turns on
+    # cross-request shared-prefix KV (the engine gains a shared block
+    # pool); tenants/slo_classes type the QoS scheduler's admission.
+    serving: Dict[str, Any] = field(default_factory=dict)
 
     # Asynchronous actor–learner PPO (docs/async_pipeline.md):
     # {"enabled": true, "staleness_window": 1, "actor_fraction": 1.0} —

@@ -51,6 +51,12 @@ def serving_smoke(mesh=None, n_prompts: int = 6) -> int:
     scfg["train"]["rollout"] = {
         "slots": 4, "admit_width": 2, "harvest_width": 2, "block_size": 4,
     }
+    # CPU-tier SLO budgets: queue waits here include jit COMPILE walls
+    # (seconds), which production latency never pays — a tight default
+    # budget would trip slo-breach on a perfectly healthy run
+    scfg["train"]["serving"] = {
+        "slo_classes": {"standard": {"queue_wait_budget_ms": 120000}},
+    }
     server = InferenceServer(TRLConfig.from_dict(scfg), checkpoint_dir=ckpt)
 
     rng = np.random.default_rng(0)
@@ -124,6 +130,174 @@ def serving_smoke(mesh=None, n_prompts: int = 6) -> int:
     return 0
 
 
+def multi_tenant_smoke(mesh=None) -> int:
+    """The serving-tier QoS smoke (docs/serving.md; CI serving-smoke
+    job, multi-tenant step). One CPU run must demonstrate:
+
+    - **priority admission**: a high-priority tenant's requests,
+      submitted AFTER a low-priority tenant's, complete strictly ahead
+      of them (the slot pool is smaller than the request count, so
+      ordering is a scheduling decision, not an accident);
+    - **quota without starvation**: the low-priority tenant is
+      token-bucket-throttled (observable throttled rounds) yet every
+      one of its requests still completes;
+    - **streamed TTFT < wait-for-harvest TTFT**: the first streamed
+      token of a ``stream=True`` request arrives strictly before the
+      same request's harvested result exists;
+    - **prefix sharing**: a shared system-prompt prefix across tenants
+      yields a nonzero ``engine/prefix_hit_rate``;
+    - **per-tenant metrics**: ``serve/*[tenant=...]`` histogram keys
+      land in the artifact with nonzero counts;
+    - **zero health events** on this clean run.
+    """
+    import numpy as np
+
+    from trlx_tpu import telemetry
+    from trlx_tpu.analysis import harness
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.inference.server import InferenceServer
+
+    scfg = harness.tiny_config_dict("ppo", mesh=mesh)
+    scfg["train"]["rollout"] = {
+        "slots": 4, "admit_width": 2, "harvest_width": 2, "block_size": 4,
+    }
+    server = InferenceServer(
+        TRLConfig.from_dict(scfg),
+        serving={
+            "prefix_cache_blocks": 16,
+            # generous CPU-tier budgets (queue waits include compile
+            # walls); the slo-breach detector is unit-tested with tight
+            # budgets in tests/test_serving.py
+            "slo_classes": {
+                "interactive": {"queue_wait_budget_ms": 120000},
+                "standard": {"queue_wait_budget_ms": 120000},
+            },
+            "tenants": {
+                "gold": {"priority": 10, "slo_class": "interactive"},
+                # burst covers ONE request's cost (Q + R tokens), the
+                # rate refills roughly two requests/second: bronze is
+                # throttled to a trickle but never starves
+                "bronze": {
+                    "priority": 0, "rate": 30.0, "burst": 14.0,
+                    "slo_class": "standard",
+                },
+            },
+        },
+    )
+    Q, R = server.query_length, server.engine.R
+    rng = np.random.default_rng(0)
+    system_prefix = [5, 6, 7, 8]  # shared across BOTH tenants
+    def make_prompts(n):
+        return [
+            system_prefix + list(rng.integers(1, 30, Q - len(system_prefix)))
+            for _ in range(n)
+        ]
+
+    # low-priority bronze submits FIRST; gold afterwards — priority
+    # admission must still serve gold ahead of bronze
+    bronze = server.submit(make_prompts(4), tenant="bronze")
+    gold = server.submit(make_prompts(4), tenant="gold")
+    stream_rid = server.submit(
+        make_prompts(1), tenant="gold", stream=True
+    )[0]
+
+    # streamed TTFT: pull the first token through the stream iterator
+    # (it pumps the serving loop); wait-for-harvest TTFT: keep pumping
+    # until the SAME request's harvested result exists
+    t0 = telemetry.monotonic()
+    first_token = next(server.stream(stream_rid))
+    ttft_stream_ms = (telemetry.monotonic() - t0) * 1000.0
+    result_at_first_token = server.poll(stream_rid)
+    while server.poll(stream_rid) is None:
+        server._pump_once()
+    ttft_harvest_ms = (telemetry.monotonic() - t0) * 1000.0
+
+    server.flush()
+    # engine rows are allocated in admission-feed order: the scheduler's
+    # decision trail (captured before wait() pops the bookkeeping)
+    admit_pos = dict(server._req_row)
+    results = server.wait(bronze + gold + [stream_rid])
+
+    order = server.completion_order
+    rank = {rid: i for i, rid in enumerate(order)}
+    gold_ranks = [rank[r] for r in gold + [stream_rid]]
+    bronze_ranks = [rank[r] for r in bronze]
+    gold_rows = [admit_pos[r] for r in gold + [stream_rid]]
+    bronze_rows = [admit_pos[r] for r in bronze]
+    stats = server.stats()
+    metrics = server.metrics()
+    events = server.health_events
+
+    record = {
+        "completion_order_tenants": [
+            "gold" if r in set(gold + [stream_rid]) else "bronze"
+            for r in order
+        ],
+        "gold_ranks": gold_ranks,
+        "bronze_ranks": bronze_ranks,
+        "gold_admission_rows": gold_rows,
+        "bronze_admission_rows": bronze_rows,
+        "first_streamed_token": int(first_token),
+        "ttft_stream_ms": round(ttft_stream_ms, 3),
+        "ttft_harvest_ms": round(ttft_harvest_ms, 3),
+        "scheduler_throttled_rounds": stats["scheduler/throttled_rounds"],
+        "prefix_hit_rate": stats["engine/prefix_hit_rate"],
+        "prefix_blocks_saved": stats["engine/prefix_blocks_saved"],
+        "released_placeholders": stats["engine/released"],
+        "health_events": [ev.to_dict() for ev in events],
+        "serving_metrics": metrics,
+    }
+    print(json.dumps(record))
+
+    failures = []
+    if len(results) != 9 or any(
+        results[r]["length"] < 1 for r in results
+    ):
+        failures.append("not every request completed")
+    if max(gold_rows) > min(bronze_rows):
+        failures.append(
+            "priority inversion: a bronze request was ADMITTED before "
+            "the last gold request despite submitting earlier with "
+            "lower priority"
+        )
+    if sorted(gold_ranks[:4]) != list(range(4)):
+        failures.append(
+            "the first completions were not the first gold wave"
+        )
+    # single-process CPU smoke: these are host-side scheduler/engine
+    # counters (never device collectives), so branching cannot desync
+    if stats["scheduler/throttled_rounds"] < 1:  # tpu-lint: disable=host-branch
+        failures.append("bronze quota never throttled")
+    if result_at_first_token is not None:
+        failures.append("harvest completed before the first streamed token")
+    if not ttft_stream_ms < ttft_harvest_ms:
+        failures.append(
+            f"streamed TTFT {ttft_stream_ms:.1f}ms not below "
+            f"wait-for-harvest TTFT {ttft_harvest_ms:.1f}ms"
+        )
+    if not stats["engine/prefix_hit_rate"] > 0:  # tpu-lint: disable=host-branch
+        failures.append("prefix sharing produced zero hits")
+    if telemetry.get_metrics().enabled:
+        for tenant in ("gold", "bronze"):
+            key = f"serve/queue_wait_ms[tenant={tenant}]"
+            if not metrics.get(key, {}).get("count"):
+                failures.append(f"missing per-tenant histogram {key}")
+    if events:
+        failures.append(f"{len(events)} health events on a clean run")
+    if failures:
+        for f in failures:
+            print(f"mt-smoke FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        "mt-smoke PASS: priority ordering, quota-throttle-no-starve, "
+        f"streamed TTFT {ttft_stream_ms:.0f}ms < harvest "
+        f"{ttft_harvest_ms:.0f}ms, prefix hit rate "
+        f"{stats['engine/prefix_hit_rate']:.2f}, zero health events",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     _force_cpu_platform()
     parser = argparse.ArgumentParser(
@@ -135,9 +309,18 @@ def main(argv=None) -> int:
         help="run the serving smoke: checkpoint round-trip through "
         "InferenceServer, assert completions + zero health events",
     )
+    parser.add_argument(
+        "--mt-smoke", action="store_true",
+        help="run the multi-tenant QoS smoke: priority ordering, "
+        "quota throttling without starvation, streamed TTFT below "
+        "harvest TTFT, nonzero prefix-sharing hit rate, per-tenant "
+        "serve/* histograms, zero health events",
+    )
     args = parser.parse_args(argv)
     if args.smoke:
         return serving_smoke()
+    if args.mt_smoke:
+        return multi_tenant_smoke()
     parser.print_help()
     return 2
 

@@ -118,11 +118,31 @@ class EngineStats:
     num_slots: int = 0
     done_polls: int = 0  # [B]-bool device->host fetches actually paid
     weight_pushes: int = 0  # mid-generation behavior refreshes applied
+    released: int = 0  # placeholder rows force-finished on admission
+    # cross-request prefix sharing (serving tier): block-granular lookup
+    # accounting per admitted real row — hits are blocks served from the
+    # shared pool WITHOUT this row publishing them (true reuse), saved
+    # counts the private-region writes skipped (hit + published blocks)
+    prefix_lookup_blocks: int = 0
+    prefix_hit_blocks: int = 0
+    prefix_published_blocks: int = 0
 
     @property
     def slot_util(self) -> float:
         denom = self.num_slots * self.decode_steps
         return self.occupancy_sum / denom if denom else 0.0
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        if not self.prefix_lookup_blocks:
+            return 0.0
+        return self.prefix_hit_blocks / self.prefix_lookup_blocks
+
+    @property
+    def prefix_blocks_saved(self) -> int:
+        """Private-region prefix blocks never written (served from or
+        redirected into the shared pool)."""
+        return self.prefix_hit_blocks + self.prefix_published_blocks
 
     def to_dict(self) -> Dict[str, float]:
         return {
@@ -134,6 +154,9 @@ class EngineStats:
             "engine/slot_util": round(self.slot_util, 4),
             "engine/done_polls": float(self.done_polls),
             "engine/weight_pushes": float(self.weight_pushes),
+            "engine/released": float(self.released),
+            "engine/prefix_hit_rate": round(self.prefix_hit_rate, 4),
+            "engine/prefix_blocks_saved": float(self.prefix_blocks_saved),
         }
 
 
@@ -162,6 +185,17 @@ class ContinuousBatchingEngine:
         the cost of up to k-1 idle steps per finished slot.
     :param mesh / param_shardings / cache_sharding: optional GSPMD
         pinning; ``cache_sharding`` shards the capacity axis (sp).
+    :param prefix_pool_blocks: size (in blocks) of the cross-request
+        shared-prefix KV pool (``inference/kv_cache.py``; managed by
+        :class:`trlx_tpu.serving.prefix_cache.PrefixBlockPool`). 0 — the
+        default, and the trainer collect path — disables sharing and
+        keeps every jitted program byte-identical to the pool-less
+        engine.
+    :param stream_taps: make ``decode_step`` additionally return this
+        step's (token, live) vectors so the host can stream tokens into
+        per-request queues (:mod:`trlx_tpu.serving.streaming`) the step
+        they are produced instead of at harvest. Off (the default) keeps
+        the trainer-path program unchanged.
     """
 
     def __init__(
@@ -181,6 +215,8 @@ class ContinuousBatchingEngine:
         param_shardings=None,
         cache_sharding=None,
         with_values: bool = True,
+        prefix_pool_blocks: int = 0,
+        stream_taps: bool = False,
     ):
         self.gen_config = dataclasses.replace(gen_config, per_row_rng=True)
         self.Q = int(query_length)
@@ -190,6 +226,11 @@ class ContinuousBatchingEngine:
         self.num_slots = int(num_slots)
         self.block_size = choose_block_size(self.capacity, block_size)
         self.n_blocks = self.capacity // self.block_size
+        self.prefix_pool_blocks = int(prefix_pool_blocks)
+        self.stream_taps = bool(stream_taps)
+        #: host callback ``{row: token_id} -> None`` fired per decode
+        #: step with the step's live emissions (requires stream_taps)
+        self.token_sink: Optional[Callable[[Dict[int, int]], None]] = None
         self.with_values = with_values
         self.done_poll_interval = int(done_poll_interval)
         if self.done_poll_interval < 1:
@@ -238,7 +279,9 @@ class ContinuousBatchingEngine:
         self._state: Optional[EngineState] = None
         self._params = None
         self._phase_key = None
-        self._queue: List[Tuple[np.ndarray, np.ndarray, int]] = []
+        # queue entries: (ids, mask, row, shared_map|None,
+        #                 publish_map|None, release)
+        self._queue: List[Tuple] = []
         self._free: List[int] = []
         self._busy_rows: Dict[int, int] = {}  # slot -> row index
         self._done_slots: List[int] = []
@@ -252,6 +295,11 @@ class ContinuousBatchingEngine:
         self._pending_params = None
         self._pending_version: Optional[int] = None
         self._steps_since_poll = 0
+        #: host callback fired with the admitted rows' indices right
+        #: after each prefill dispatch — the serving tier marks newly
+        #: published prefix blocks ready for later admission groups here
+        #: (dispatch order guarantees the device writes land first)
+        self._admit_listener: Optional[Callable[[List[int]], None]] = None
         self.stats = EngineStats(num_slots=self.num_slots)
         # per-request latency bookkeeping (docs/observability.md,
         # "Serving metrics"): submit/admit/prefill/complete marks on the
@@ -274,7 +322,11 @@ class ContinuousBatchingEngine:
         return state
 
     def _make_state(self) -> EngineState:
-        from trlx_tpu.inference.kv_cache import identity_block_tables
+        from trlx_tpu.inference.kv_cache import (
+            empty_share_tables,
+            identity_block_tables,
+            init_shared_pool,
+        )
 
         B, Q, R, V = self.num_slots, self.Q, self.R, self.vocab_size
         cfg = self.gen_config
@@ -286,6 +338,25 @@ class ContinuousBatchingEngine:
         cache = tuple(
             dict(layer, block_tables=jnp.array(tables)) for layer in linear
         )
+        if self.prefix_pool_blocks > 0:
+            def with_pool(layer):
+                kv = layer["k"]
+                pool = init_shared_pool(
+                    self.prefix_pool_blocks,
+                    self.block_size,
+                    kv.shape[2],
+                    kv.shape[3],
+                    kv.dtype,
+                    "int8" if "k_scale" in layer else "bfloat16",
+                )
+                return dict(
+                    layer,
+                    **pool,
+                    shared_tables=empty_share_tables(B, self.n_blocks),
+                    publish_tables=empty_share_tables(B, self.n_blocks),
+                )
+
+            cache = tuple(with_pool(layer) for layer in cache)
         return EngineState(
             cache=cache,
             row_keys=jnp.zeros((B, 2), jnp.uint32),
@@ -307,15 +378,23 @@ class ContinuousBatchingEngine:
     def state_sharding(self):
         """Sharding pytree for :class:`EngineState`: slot axis over
         dp×fsdp everywhere; cache K/V capacity axis additionally over sp
-        when a ``cache_sharding`` was given (the LONGCTX layout)."""
-        from trlx_tpu.parallel.mesh import batch_sharding
+        when a ``cache_sharding`` was given (the LONGCTX layout); the
+        shared-prefix pool (no slot axis — a broadcast structure every
+        data shard reads) replicates."""
+        from trlx_tpu.inference.kv_cache import SHARED_POOL_KEYS
+        from trlx_tpu.parallel.mesh import batch_sharding, replicated
 
         batch_sh = batch_sharding(self.mesh)
         cache_sh = self._cache_sharding or batch_sh
+        rep = replicated(self.mesh)
 
         def layer_sharding(layer: Dict[str, Any]) -> Dict[str, Any]:
             return {
-                k: (cache_sh if v.ndim == 4 else batch_sh)
+                k: (
+                    rep
+                    if k in SHARED_POOL_KEYS
+                    else (cache_sh if v.ndim == 4 else batch_sh)
+                )
                 for k, v in layer.items()
             }
 
@@ -355,6 +434,9 @@ class ContinuousBatchingEngine:
                 for layer in cache
             )
 
+        sharing = self.prefix_pool_blocks > 0
+        from trlx_tpu.inference.kv_cache import SHARED_POOL_KEYS
+
         def prefill(
             params,
             state: EngineState,
@@ -364,6 +446,8 @@ class ContinuousBatchingEngine:
             row_index,  # [A] int32 global draw index
             table_turns,  # [A] int32 block-table rotation per slot
             phase_key,  # [2] uint32
+            shared_map=None,  # [A, nb] int32 pool block per logical
+            publish_map=None,  # block (-1 = private / no publish)
         ) -> EngineState:
             A = prompt_ids.shape[0]
             row_keys = make_row_keys(phase_key, row_index)
@@ -381,9 +465,18 @@ class ContinuousBatchingEngine:
                 sl = {
                     k: jnp.take(v, slot_ids, axis=0)
                     for k, v in layer.items()
-                    if k != "block_tables"
+                    if k != "block_tables" and k not in SHARED_POOL_KEYS
                 }
                 sl["block_tables"] = new_tables
+                if sharing:
+                    # the pool is global — pass it whole; the admitted
+                    # rows' share/publish assignments replace the
+                    # recycled slots' stale metadata
+                    for k in SHARED_POOL_KEYS:
+                        if k in layer:
+                            sl[k] = layer[k]
+                    sl["shared_tables"] = shared_map
+                    sl["publish_tables"] = publish_map
                 return sl
 
             cache_slice = tuple(slice_layer(l) for l in state.cache)
@@ -411,12 +504,18 @@ class ContinuousBatchingEngine:
                 finished0 = jnp.zeros((A,), bool)
 
             def merge_layer(full, sl):
-                return {
-                    k: full[k]
-                    .at[slot_ids]
-                    .set(sl[k].astype(full[k].dtype), mode="drop")
-                    for k in full
-                }
+                def one(k):
+                    if k in SHARED_POOL_KEYS:
+                        # global pool: take the (possibly published-to)
+                        # pool wholesale, never slot-scattered
+                        return sl[k].astype(full[k].dtype)
+                    return (
+                        full[k]
+                        .at[slot_ids]
+                        .set(sl[k].astype(full[k].dtype), mode="drop")
+                    )
+
+                return {k: one(k) for k in full}
 
             new_cache = tuple(
                 merge_layer(f, s) for f, s in zip(state.cache, out["cache"])
@@ -524,6 +623,12 @@ class ContinuousBatchingEngine:
                 out_logprobs=out_logprobs,
                 out_values=out_values,
             )
+            if self.stream_taps:
+                # streaming decode: this step's emissions come home with
+                # the done flags so the host can route tokens the step
+                # they exist instead of at harvest (TTFT decouples from
+                # harvest-group completion)
+                return new_state, done, token, live
             return new_state, done
 
         def refill(state: EngineState, slot_ids):
@@ -541,31 +646,48 @@ class ContinuousBatchingEngine:
             active = state.active.at[slot_ids].set(False, mode="drop")
             return dataclasses.replace(state, active=active), outs
 
+        def release(state: EngineState, slot_ids):
+            """Force-finish ``slot_ids`` right after admission: the next
+            decode step emits the deterministic pad for them and flags
+            them done, so a padding placeholder costs ONE decode step
+            instead of decoding its full token budget (the serving
+            tier's partial-harvest-group fix, docs/serving.md)."""
+            finished = state.finished.at[slot_ids].set(True, mode="drop")
+            return dataclasses.replace(state, finished=finished)
+
         if self.mesh is not None and self._param_shardings is not None:
             from trlx_tpu.parallel.mesh import batch_sharding, replicated
 
             state_sh = self.state_sharding()
             batch_sh = batch_sharding(self.mesh)
             rep = replicated(self.mesh)
+            prefill_in = [
+                self._param_shardings,
+                state_sh,
+                rep,
+                batch_sh,
+                batch_sh,
+                rep,
+                rep,
+                rep,
+            ]
+            if sharing:
+                prefill_in += [rep, rep]  # shared_map, publish_map
+            decode_out = (
+                (state_sh, rep, rep, rep)
+                if self.stream_taps
+                else (state_sh, rep)
+            )
             self.prefill_jit = jax.jit(
                 prefill,
-                in_shardings=(
-                    self._param_shardings,
-                    state_sh,
-                    rep,
-                    batch_sh,
-                    batch_sh,
-                    rep,
-                    rep,
-                    rep,
-                ),
+                in_shardings=tuple(prefill_in),
                 out_shardings=state_sh,
                 donate_argnums=(1,),
             )
             self.decode_step_jit = jax.jit(
                 decode_step,
                 in_shardings=(self._param_shardings, state_sh),
-                out_shardings=(state_sh, rep),
+                out_shardings=decode_out,
                 donate_argnums=(1,),
             )
             self.refill_jit = jax.jit(
@@ -574,10 +696,17 @@ class ContinuousBatchingEngine:
                 out_shardings=(state_sh, batch_sh),
                 donate_argnums=(0,),
             )
+            self.release_jit = jax.jit(
+                release,
+                in_shardings=(state_sh, rep),
+                out_shardings=state_sh,
+                donate_argnums=(0,),
+            )
         else:
             self.prefill_jit = jax.jit(prefill, donate_argnums=(1,))
             self.decode_step_jit = jax.jit(decode_step, donate_argnums=(1,))
             self.refill_jit = jax.jit(refill, donate_argnums=(0,))
+            self.release_jit = jax.jit(release, donate_argnums=(0,))
 
     # --------------------------- host loop ----------------------------- #
 
@@ -653,12 +782,32 @@ class ContinuousBatchingEngine:
             )
         return min(versions) if versions else None
 
-    def submit(self, prompt_ids, prompt_mask) -> List[int]:
+    def submit(
+        self,
+        prompt_ids,
+        prompt_mask,
+        *,
+        shared_maps=None,
+        publish_maps=None,
+        release: bool = False,
+        submit_times=None,
+    ) -> List[int]:
         """Enqueue prompts (host arrays, [n, Q]); returns their global
         row indices (draw order — the per-row RNG identity). Carries
         the ``engine.admit`` fault-injection site (resilience/chaos.py):
         an injected admission failure drives the orchestrator's
-        fixed-sampler fallback and the server's admission retry."""
+        fixed-sampler fallback and the server's admission retry.
+
+        ``shared_maps`` / ``publish_maps`` ([n, n_blocks] int32, -1 =
+        private) are the serving tier's per-row prefix-sharing
+        assignments (requires ``prefix_pool_blocks > 0``);
+        ``release=True`` marks the batch as padding placeholders that
+        are force-finished the moment they are admitted (one decode
+        step each instead of a full token budget); ``submit_times``
+        (per-row floats on the telemetry clock) backdates the latency
+        marks to when the request entered the SERVING tier, so
+        ``serve/queue_wait_ms`` includes scheduler queueing, not just
+        the slot-pool wait."""
         from trlx_tpu.resilience import chaos
 
         chaos.check("engine.admit")
@@ -668,20 +817,47 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"submit expects [n, Q={self.Q}] prompt ids, got {ids.shape}"
             )
+        if (
+            shared_maps is not None or publish_maps is not None
+        ) and self.prefix_pool_blocks < 1:
+            raise ValueError(
+                "prefix-sharing maps need an engine built with "
+                "prefix_pool_blocks > 0"
+            )
         rows = []
         t_submit = telemetry.monotonic()
         for i in range(ids.shape[0]):
             row = self._next_row
             self._next_row += 1
-            self._queue.append((ids[i], mask[i], row))
-            self._req_times[row] = {"submitted": t_submit}
+            self._queue.append((
+                ids[i],
+                mask[i],
+                row,
+                None if shared_maps is None else np.asarray(
+                    shared_maps[i], np.int32
+                ),
+                None if publish_maps is None else np.asarray(
+                    publish_maps[i], np.int32
+                ),
+                bool(release),
+            ))
+            self._req_times[row] = {
+                "submitted": (
+                    float(submit_times[i])
+                    if submit_times is not None
+                    else t_submit
+                )
+            }
             rows.append(row)
         return rows
 
     @property
     def pending(self) -> int:
-        """Rows submitted but not yet harvested."""
-        return len(self._queue) + len(self._busy_rows) + len(self._done_slots)
+        """Rows submitted but not yet harvested. ``_busy_rows`` covers
+        decoding AND done-awaiting-harvest slots (``_done_slots`` is a
+        subset of it until harvest pops both), so it is NOT added
+        twice."""
+        return len(self._queue) + len(self._busy_rows)
 
     def pop_request_timing(self, row: int) -> Optional[Dict[str, float]]:
         """The per-request latency decomposition for a HARVESTED row,
@@ -718,6 +894,8 @@ class ContinuousBatchingEngine:
     def _admit(self) -> None:
         """Refill free slots from the queue, one padded prefill call per
         ``admit_width`` group."""
+        sharing = self.prefix_pool_blocks > 0
+        nb_prompt = self.Q // self.block_size  # shareable prompt blocks
         while self._free and self._queue:
             with telemetry.span("collect/admit", force=True):
                 A = self.admit_width
@@ -729,9 +907,13 @@ class ContinuousBatchingEngine:
                 slot_ids = np.full((A,), self.num_slots, np.int32)  # dummies
                 row_index = np.zeros((A,), np.int32)
                 turns = np.zeros((A,), np.int32)
-                for i, (slot, (ids, mask, row)) in enumerate(
-                    zip(slots, entries)
-                ):
+                shared_map = np.full((A, self.n_blocks), -1, np.int32)
+                publish_map = np.full((A, self.n_blocks), -1, np.int32)
+                released_slots = []
+                for i, (
+                    slot,
+                    (ids, mask, row, sh_row, pub_row, release),
+                ) in enumerate(zip(slots, entries)):
                     prompt_ids[i] = ids
                     prompt_mask[i] = mask
                     slot_ids[i] = slot
@@ -741,6 +923,23 @@ class ContinuousBatchingEngine:
                     # behavior-version tag: the params this row's whole
                     # prefill (and its first decode steps) run under
                     self._slot_versions[slot] = self.param_version
+                    if release:
+                        released_slots.append(slot)
+                    if sh_row is not None:
+                        shared_map[i, : len(sh_row)] = sh_row
+                    if pub_row is not None:
+                        publish_map[i, : len(pub_row)] = pub_row
+                    if sharing and not release:
+                        hits = int(
+                            np.sum(
+                                (shared_map[i] >= 0) & (publish_map[i] < 0)
+                            )
+                        )
+                        self.stats.prefix_lookup_blocks += nb_prompt
+                        self.stats.prefix_hit_blocks += hits
+                        self.stats.prefix_published_blocks += int(
+                            np.sum(publish_map[i] >= 0)
+                        )
                 args = (prompt_ids, prompt_mask)
                 if self.mesh is not None:
                     from trlx_tpu.parallel.mesh import batch_sharding
@@ -750,7 +949,7 @@ class ContinuousBatchingEngine:
             with telemetry.span(
                 "collect/prefill", force=True, admitted=take
             ):
-                self._state = self.prefill_jit(
+                prefill_args = [
                     self._params,
                     self._state,
                     jnp.asarray(slot_ids),
@@ -759,17 +958,45 @@ class ContinuousBatchingEngine:
                     jnp.asarray(row_index),
                     jnp.asarray(turns),
                     self._phase_key,
+                ]
+                if sharing:
+                    prefill_args += [
+                        jnp.asarray(shared_map),
+                        jnp.asarray(publish_map),
+                    ]
+                self._state = self.prefill_jit(*prefill_args)
+            if released_slots:
+                # padding placeholders: force-finish now so they cost
+                # one decode step, not a full token budget. Fixed
+                # admit_width call shape (num_slots = OOB dummy, the
+                # scatter drops) — one compiled program regardless of
+                # how many placeholders an admission carried.
+                rel = np.full((A,), self.num_slots, np.int32)
+                rel[: len(released_slots)] = released_slots
+                self._state = self.release_jit(
+                    self._state, jnp.asarray(rel)
                 )
+                self.stats.released += len(released_slots)
             # prefill computes the group's FIRST tokens, so its dispatch
             # end is the host-side time-to-first-token mark
             t_first = telemetry.monotonic()
-            for _, _, row in entries:
-                marks = self._req_times.get(row)
+            for entry in entries:
+                marks = self._req_times.get(entry[2])
                 if marks is not None:
                     marks["admitted"] = t_admit
                     marks["first_token"] = t_first
             self.stats.prefills += 1
             self.stats.admitted += take
+            if sharing:
+                registry = telemetry.get_metrics()
+                registry.gauge("engine/prefix_hit_rate").set(
+                    self.stats.prefix_hit_rate
+                )
+                registry.gauge("engine/prefix_blocks_saved").set(
+                    self.stats.prefix_blocks_saved
+                )
+            if self._admit_listener is not None:
+                self._admit_listener([e[2] for e in entries])
 
     def _harvest_ready(self) -> Iterator[Dict[str, Any]]:
         """Yield fixed-width harvest groups while enough slots are done."""
@@ -840,32 +1067,92 @@ class ContinuousBatchingEngine:
                     f"harvest group ({len(self._done_slots)} done < "
                     f"{C}) — target/harvest_width mismatch"
                 )
+            self._decode_once()
+
+    def _decode_once(self) -> None:
+        """Dispatch one decode step for the whole pool and run the
+        amortized done-poll + streaming-tap bookkeeping."""
+        if self.stream_taps:
+            self._state, done, token, live = self.decode_step_jit(
+                self._params, self._state
+            )
+        else:
             self._state, done = self.decode_step_jit(
                 self._params, self._state
             )
-            try:
-                done.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass
-            self.stats.decode_steps += 1
-            self.stats.occupancy_sum += len(self._busy_rows)
-            # amortized done polling: the flags are sticky (a finished
-            # slot stays done until harvested), so fetching only every
-            # k-th step's flags is exact — k=1 reproduces the
-            # poll-every-step loop bitwise, and the async copy above has
-            # k dispatches to land the transfer before the host reads it
-            self._steps_since_poll += 1
-            if self._steps_since_poll < self.done_poll_interval:
-                continue
-            self._steps_since_poll = 0
-            done_host = np.asarray(jax.device_get(done))
-            self.stats.done_polls += 1
-            # occupancy timeseries: one gauge sample per paid done-poll
-            # (the registry's ring is bounded; one host call per poll)
-            # — the Perfetto counter track rides these samples
-            telemetry.get_metrics().gauge("engine/slot_util").set(
-                self.stats.slot_util
-            )
-            for slot, row in list(self._busy_rows.items()):
-                if done_host[slot] and slot not in self._done_slots:
-                    self._done_slots.append(slot)
+            token = live = None
+        try:
+            done.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        self.stats.decode_steps += 1
+        self.stats.occupancy_sum += len(self._busy_rows)
+        if token is not None and self.token_sink is not None:
+            # streaming tap: route this step's live emissions to the
+            # per-request queues NOW — time-to-first-token decouples
+            # from harvest-group completion (the per-step fetch is the
+            # streaming cost; non-streaming runs leave token_sink unset
+            # and the unfetched outputs are dropped on device)
+            tok_host = np.asarray(jax.device_get(token))
+            live_host = np.asarray(jax.device_get(live))
+            emitted = {
+                row: int(tok_host[slot])
+                for slot, row in self._busy_rows.items()
+                if live_host[slot]
+            }
+            if emitted:
+                self.token_sink(emitted)
+        # amortized done polling: the flags are sticky (a finished
+        # slot stays done until harvested), so fetching only every
+        # k-th step's flags is exact — k=1 reproduces the
+        # poll-every-step loop bitwise, and the async copy above has
+        # k dispatches to land the transfer before the host reads it
+        self._steps_since_poll += 1
+        if self._steps_since_poll < self.done_poll_interval:
+            return
+        self._steps_since_poll = 0
+        done_host = np.asarray(jax.device_get(done))
+        self.stats.done_polls += 1
+        # occupancy timeseries: one gauge sample per paid done-poll
+        # (the registry's ring is bounded; one host call per poll)
+        # — the Perfetto counter track rides these samples
+        telemetry.get_metrics().gauge("engine/slot_util").set(
+            self.stats.slot_util
+        )
+        for slot, row in list(self._busy_rows.items()):
+            if done_host[slot] and slot not in self._done_slots:
+                self._done_slots.append(slot)
+
+    # ------------------------- serving interface ----------------------- #
+
+    @property
+    def free_capacity(self) -> int:
+        """Slots with neither an occupant nor a queued claim — how many
+        more requests the serving scheduler may hand the engine without
+        overcommitting the pool. Occupants are exactly ``_busy_rows``
+        (which includes done-awaiting-harvest slots until the harvest
+        pops them); counting ``_done_slots`` again would understate
+        capacity and starve admission while a partial harvest group
+        waits for peers."""
+        return (
+            self.num_slots
+            - len(self._busy_rows)
+            - len(self._queue)
+        )
+
+    def pump(self) -> List[Dict[str, Any]]:
+        """One serving-loop iteration: harvest every ready fixed-width
+        group, admit queued prompts into vacated slots, then advance
+        decode one step. Returns the harvested groups (possibly empty).
+
+        This is the scheduler-driven counterpart of :meth:`drive` — the
+        serving tier interleaves QoS admission decisions between
+        iterations instead of committing a whole phase's prompt set up
+        front. Raises nothing on an idle pool (an empty pump is how the
+        serving loop discovers it is drained)."""
+        groups = list(self._harvest_ready())
+        self._apply_pending_push()
+        self._admit()
+        if self._busy_rows:
+            self._decode_once()
+        return groups

@@ -34,6 +34,39 @@ breaking the dp×fsdp batch sharding that keeps decode local to each data
 shard. Per-slot regions keep GSPMD layouts identical to the fixed cache;
 the paging machinery (tables, block-granular recycling) is unchanged,
 only the allocator's arena is per-slot.
+
+**Cross-request prefix sharing** (the serving tier,
+:mod:`trlx_tpu.serving`): when the engine is built with
+``prefix_pool_blocks > 0`` each layer additionally carries
+
+- ``shared_k`` / ``shared_v`` (+ int8 scales) — a *replicated* flat pool
+  of ``prefix_pool_blocks * block_size`` positions holding published
+  prefix KV (replicated like the params: system prompts are small and
+  every data shard reads them, so the pool is a broadcast structure, not
+  a batch-sharded one — the per-slot regions' sharding story is
+  untouched);
+- ``shared_tables[b, j]`` — logical block ``j`` of slot ``b`` READS from
+  shared-pool block ``shared_tables[b, j]`` when ``>= 0`` (else from the
+  slot's private region through ``block_tables``);
+- ``publish_tables[b, j]`` — prefill WRITES logical block ``j``'s K/V
+  into shared-pool block ``publish_tables[b, j]`` when ``>= 0`` (the
+  donor request publishing a new prefix).
+
+Sharing semantics are exact, not approximate: a shared block's bits are
+the donor prefill's bits, which equal the bits the reader's own prefill
+computes for the same leading padded columns (causal attention — column
+``j``'s K/V depends only on columns ``<= j``; same program shape, same
+params, same columns ⇒ same bits), and the read side is a gather — a
+permutation that re-associates nothing. Private writes to shared
+columns are dropped (the region's leading blocks stay unwritten — the
+``engine/prefix_blocks_saved`` accounting), writes during decode land at
+positions ``>= Q`` which are never shared, so a shared block is
+immutable after publication — copy-on-first-divergent-write degenerates
+to "the first divergent block is private from admission", enforced
+host-side by :class:`trlx_tpu.serving.prefix_cache.PrefixBlockPool`
+(which only maps *fully-covered* leading blocks and allocates a fresh
+pool block on any content divergence instead of mutating a published
+one).
 """
 
 from __future__ import annotations
@@ -115,6 +148,50 @@ def init_paged_cache(
     )
 
 
+def empty_share_tables(n_slots: int, n_blocks: int) -> jax.Array:
+    """[B, n_blocks] int32 all ``-1`` — no block shared/published."""
+    return jnp.full((n_slots, n_blocks), -1, jnp.int32)
+
+
+def init_shared_pool(
+    pool_blocks: int,
+    block_size: int,
+    n_head: int,
+    head_dim: int,
+    dtype,
+    kv_cache_dtype: str = "bfloat16",
+) -> Dict[str, jax.Array]:
+    """Per-layer shared-prefix pool buffers: ``pool_blocks * block_size``
+    flat positions in the private regions' storage layout (int8 pools
+    carry scales exactly like the int8 linear cache)."""
+    if pool_blocks < 1:
+        raise ValueError(
+            f"prefix pool needs >= 1 block, got {pool_blocks}"
+        )
+    n_pos = pool_blocks * block_size
+    shape = (n_pos, n_head, head_dim)
+    if kv_cache_dtype == "int8":
+        sshape = (n_pos, n_head, 1)
+        return {
+            "shared_k": jnp.zeros(shape, jnp.int8),
+            "shared_v": jnp.zeros(shape, jnp.int8),
+            "shared_k_scale": jnp.zeros(sshape, jnp.bfloat16),
+            "shared_v_scale": jnp.zeros(sshape, jnp.bfloat16),
+        }
+    return {
+        "shared_k": jnp.zeros(shape, jnp.dtype(dtype)),
+        "shared_v": jnp.zeros(shape, jnp.dtype(dtype)),
+    }
+
+
+#: cache-dict keys that belong to the shared-prefix pool (global, never
+#: sliced/merged along the slot axis) vs the per-slot share metadata
+SHARED_POOL_KEYS = (
+    "shared_k", "shared_v", "shared_k_scale", "shared_v_scale",
+)
+SHARE_TABLE_KEYS = ("shared_tables", "publish_tables")
+
+
 def physical_positions(
     block_tables: jax.Array,  # [B, n_blocks] int32
     positions: jax.Array,  # [B, T] logical positions (may be >= capacity)
@@ -159,6 +236,36 @@ def _scatter_rows(pool: jax.Array, phys: jax.Array, rows: jax.Array) -> jax.Arra
     return pool.at[b_idx, phys].set(rows.astype(pool.dtype), mode="drop")
 
 
+def _publish_rows(
+    pool: jax.Array, pub_pos: jax.Array, rows: jax.Array
+) -> jax.Array:
+    """Scatter ``rows`` [B, T, ...] into the flat shared pool
+    [pool_positions, ...] at ``pub_pos`` [B, T]; OOB (== pool size)
+    drops — rows without a publish assignment write nowhere. The host
+    pool allocator guarantees distinct rows never publish to the same
+    block, so the scatter is collision-free."""
+    idx = pub_pos.reshape(-1)
+    flat = rows.reshape((-1,) + rows.shape[2:])
+    return pool.at[idx].set(flat.astype(pool.dtype), mode="drop")
+
+
+def _shared_gather(
+    shared_tables: jax.Array,  # [B, n_blocks] int32, -1 = private
+    pool: jax.Array,  # [pool_positions, H, ...] shared values
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per logical position, the shared-pool value (garbage where the
+    block is private) and the [B, capacity] bool mask of shared
+    positions — the read-side overlay inputs."""
+    n_blocks = shared_tables.shape[-1]
+    bs = capacity // n_blocks
+    cols = jnp.arange(capacity, dtype=jnp.int32)
+    sh_blk = jnp.take(shared_tables, cols // bs, axis=1)  # [B, capacity]
+    sh_pos = sh_blk * bs + cols[None, :] % bs
+    safe = jnp.clip(sh_pos, 0, pool.shape[0] - 1)
+    return pool[safe], sh_blk >= 0
+
+
 def paged_write_read(
     cache_kv: Dict[str, jax.Array],
     k: jax.Array,  # [B, T, H, Dh] new keys (compute dtype)
@@ -183,31 +290,97 @@ def paged_write_read(
     phys = physical_positions(tables, positions, capacity)
     view = logical_view_index(tables, capacity)
 
+    sharing = "shared_tables" in cache_kv
+    pub_pos = None
+    if sharing:
+        shared_tables = cache_kv["shared_tables"]
+        publish_tables = cache_kv["publish_tables"]
+        n_blocks = shared_tables.shape[-1]
+        bs = capacity // n_blocks
+        pool_size = cache_kv["shared_k"].shape[0]
+        col_blk = jnp.clip(positions // bs, 0, n_blocks - 1)
+        in_range = (positions >= 0) & (positions < capacity)
+        # private writes to shared columns drop: the pool serves those
+        # reads and the region's leading blocks stay unwritten (the
+        # engine/prefix_blocks_saved accounting)
+        shared_at = (
+            jnp.take_along_axis(shared_tables, col_blk, axis=1) >= 0
+        )
+        phys = jnp.where(shared_at & in_range, capacity, phys)
+        # publish: the donor's prefix columns scatter into the pool (a
+        # reader mapped to the same blocks in the SAME call gathers the
+        # just-written bits — identical to what it computed in-flight)
+        pub_blk = jnp.take_along_axis(publish_tables, col_blk, axis=1)
+        pub_pos = jnp.where(
+            (pub_blk >= 0) & in_range,
+            pub_blk * bs + positions % bs,
+            pool_size,
+        )
+
+    def overlay(full, pool_key, scale_key=None):
+        if not sharing:
+            return full
+        pool_vals, mask = _shared_gather(
+            cache_kv["shared_tables"], new_kv[pool_key], capacity
+        )
+        vals = pool_vals.astype(dtype)
+        if scale_key is not None:
+            scales, _ = _shared_gather(
+                cache_kv["shared_tables"], new_kv[scale_key], capacity
+            )
+            vals = vals * scales.astype(dtype)
+        return jnp.where(mask[..., None, None], vals, full)
+
+    def carry(new_kv):
+        """Thread the share metadata (+ updated pools) through so the
+        next step's cache dict keeps the full layout."""
+        new_kv["block_tables"] = tables
+        if sharing:
+            new_kv["shared_tables"] = cache_kv["shared_tables"]
+            new_kv["publish_tables"] = cache_kv["publish_tables"]
+        return new_kv
+
     if "k_scale" in cache_kv:
         from trlx_tpu.models.gpt2 import quantize_kv
 
         k_q, k_s = quantize_kv(k)
         v_q, v_s = quantize_kv(v)
-        new_kv = {
+        new_kv = carry({
             "k": _scatter_rows(cache_kv["k"], phys, k_q),
             "v": _scatter_rows(cache_kv["v"], phys, v_q),
             "k_scale": _scatter_rows(cache_kv["k_scale"], phys, k_s),
             "v_scale": _scatter_rows(cache_kv["v_scale"], phys, v_s),
-            "block_tables": tables,
-        }
+        })
+        if sharing:
+            new_kv["shared_k"] = _publish_rows(
+                cache_kv["shared_k"], pub_pos, k_q
+            )
+            new_kv["shared_v"] = _publish_rows(
+                cache_kv["shared_v"], pub_pos, v_q
+            )
+            new_kv["shared_k_scale"] = _publish_rows(
+                cache_kv["shared_k_scale"], pub_pos, k_s
+            )
+            new_kv["shared_v_scale"] = _publish_rows(
+                cache_kv["shared_v_scale"], pub_pos, v_s
+            )
         k_full = _gather_logical(new_kv["k"], view).astype(dtype) * (
             _gather_logical(new_kv["k_scale"], view).astype(dtype)
         )
         v_full = _gather_logical(new_kv["v"], view).astype(dtype) * (
             _gather_logical(new_kv["v_scale"], view).astype(dtype)
         )
+        k_full = overlay(k_full, "shared_k", "shared_k_scale")
+        v_full = overlay(v_full, "shared_v", "shared_v_scale")
         return k_full, v_full, new_kv
 
-    new_kv = {
+    new_kv = carry({
         "k": _scatter_rows(cache_kv["k"], phys, k),
         "v": _scatter_rows(cache_kv["v"], phys, v),
-        "block_tables": tables,
-    }
-    k_full = _gather_logical(new_kv["k"], view)
-    v_full = _gather_logical(new_kv["v"], view)
+    })
+    if sharing:
+        new_kv["shared_k"] = _publish_rows(cache_kv["shared_k"], pub_pos, k)
+        new_kv["shared_v"] = _publish_rows(cache_kv["shared_v"], pub_pos, v)
+    k_full = overlay(_gather_logical(new_kv["k"], view), "shared_k")
+    v_full = overlay(_gather_logical(new_kv["v"], view), "shared_v")
     return k_full, v_full, new_kv

@@ -1,45 +1,55 @@
-"""Standalone batched serving over the continuous-batching engine.
+"""Multi-tenant serving over the continuous-batching engine.
 
-The "millions of users" half of the ROADMAP item: the same
-slot-admission engine the collect phase drives
-(:mod:`trlx_tpu.inference.engine`) exposed as a trainer-less serving
-API — load a policy (from-scratch config, HF conversion, or a trainer
-checkpoint directory), ``submit`` prompt batches, ``poll`` completed
-generations. No optimizer, no buffer, no orchestrator: the model
-forward, the paged KV cache, and the admission loop are the whole
-dependency surface.
+The request tier of the ROADMAP "millions of users" direction
+(docs/serving.md): :class:`InferenceServer` is rebuilt on the
+:mod:`trlx_tpu.serving` subsystem —
 
-Quickstart (docs/inference.md):
+- **QoS scheduling**: every ``submit`` becomes a typed
+  :class:`~trlx_tpu.serving.scheduler.Request` (tenant, priority, SLO
+  class, deadline) in the :class:`~trlx_tpu.serving.scheduler.
+  QoSScheduler`'s per-tenant queues; vacated decode slots are fed by
+  priority-with-aging order under per-tenant token-bucket quotas, with
+  SLO pressure read back from the ``serve/*`` latency histograms.
+- **Cross-request prefix sharing**: with
+  ``serving.prefix_cache_blocks > 0`` the engine carries a shared KV
+  pool and the :class:`~trlx_tpu.serving.prefix_cache.PrefixBlockPool`
+  maps common prompt prefixes (system prompts, few-shot headers) onto
+  refcounted shared blocks — published once, gathered read-only by
+  every later request with the same leading columns (bitwise-exact;
+  docs/serving.md "Prefix sharing").
+- **Streaming decode**: ``submit(..., stream=True)`` opens a bounded
+  per-request token queue fed by the engine's per-decode-step tap —
+  tokens arrive the step they exist, so TTFT decouples from
+  harvest-group completion.
+- The old padding waste is gone: partial final harvest groups pad with
+  *placeholder* rows that are force-finished on admission (one decode
+  step each), not decoded to their full token budget.
 
-    from trlx_tpu.data.configs import TRLConfig
-    from trlx_tpu.inference.server import InferenceServer
-
-    server = InferenceServer(TRLConfig.load_yaml("configs/ppo_gpt2.yml"),
-                             checkpoint_dir="ckpts")
-    ids = server.submit([[464, 3290, 318], [1212, 318]])
-    results = server.wait(ids)          # {id: {"tokens": ..., "text": ...}}
-
-Request lifecycle: ``submit`` left-pads and enqueues (host), the engine
-admits into vacated decode slots, ``flush``/``wait`` drive the loop;
-results are retained until ``pop_result``/``wait`` hands them out. A
-:class:`~trlx_tpu.telemetry.health.HealthMonitor` watches per-group
-generation stats (``health/`` series — non-finite logprobs/values trip
-``nan-precursor``), so a served checkpoint that decodes garbage
-surfaces as health events, not silent junk; the CI ``serving-smoke``
-job asserts a clean run stays at zero events.
+Request lifecycle: ``submit`` left-pads, types, and enqueues with the
+scheduler (host); the serving pump moves scheduler picks into engine
+slots as they vacate; ``flush``/``wait`` run the pump to completion;
+results are retained until ``pop_result``/``wait`` hands them out.
+A :class:`~trlx_tpu.telemetry.health.HealthMonitor` watches per-group
+generation stats (non-finite logprobs/values trip ``nan-precursor``)
+and the per-tenant SLO ratios (queue-wait p95 over the class budget
+trips ``slo-breach``); the CI ``serving-smoke`` jobs assert clean runs
+stay at zero events.
 """
 
 from __future__ import annotations
 
+import itertools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.serving.scheduler import DEFAULT_TENANT, tenant_metric_key
 
 #: the per-request latency histograms every served request feeds
-#: (docs/observability.md "Serving metrics") — the substrate QoS
-#: scheduling will gate on; the CI serving-smoke asserts these keys
+#: (docs/observability.md "Serving metrics") — the series QoS
+#: scheduling gates on; the CI serving-smoke asserts these keys
 SERVE_HISTOGRAMS = (
     "serve/queue_wait_ms",
     "serve/prefill_ms",
@@ -50,36 +60,53 @@ SERVE_HISTOGRAMS = (
 
 
 def observe_request_metrics(
-    registry, timing: Dict[str, float], tokens: int
+    registry,
+    timing: Dict[str, float],
+    tokens: int,
+    tenant: Optional[str] = None,
 ) -> None:
     """Feed one completed request's engine timing decomposition
     (:meth:`~trlx_tpu.inference.engine.ContinuousBatchingEngine.
     pop_request_timing`) into the latency histograms: queue wait,
     prefill, time-to-first-token, per-token decode (``decode_ms`` over
-    the generated token count), end-to-end."""
-    registry.histogram("serve/queue_wait_ms").observe(
-        timing.get("queue_wait_ms", 0.0)
-    )
-    registry.histogram("serve/prefill_ms").observe(
-        timing.get("prefill_ms", 0.0)
-    )
-    registry.histogram("serve/ttft_ms").observe(timing.get("ttft_ms", 0.0))
-    registry.histogram("serve/decode_per_token_ms").observe(
-        timing.get("decode_ms", 0.0) / max(1, int(tokens))
-    )
-    registry.histogram("serve/e2e_ms").observe(timing.get("e2e_ms", 0.0))
+    the generated token count), end-to-end. With ``tenant`` given, each
+    observation ALSO lands in the tenant-labeled twin
+    (``serve/queue_wait_ms[tenant=acme]``), so per-tenant SLOs are
+    assertable — not just aggregates."""
+    values = {
+        "serve/queue_wait_ms": timing.get("queue_wait_ms", 0.0),
+        "serve/prefill_ms": timing.get("prefill_ms", 0.0),
+        "serve/ttft_ms": timing.get("ttft_ms", 0.0),
+        "serve/decode_per_token_ms": (
+            timing.get("decode_ms", 0.0) / max(1, int(tokens))
+        ),
+        "serve/e2e_ms": timing.get("e2e_ms", 0.0),
+    }
+    for key, value in values.items():
+        registry.histogram(key).observe(value)
+        if tenant is not None:
+            registry.histogram(tenant_metric_key(key, tenant)).observe(
+                value
+            )
     registry.counter("serve/requests_completed").inc()
+    if tenant is not None:
+        registry.counter(
+            tenant_metric_key("serve/requests_completed", tenant)
+        ).inc()
 
 
 class InferenceServer:
-    """Submit/poll batched generation against a loaded policy.
+    """Submit/poll multi-tenant batched generation against a loaded
+    policy.
 
     :param config: :class:`TRLConfig` (or its dict form) — ``model``
         selects the architecture/checkpoint conversion, ``train.mesh``
         the device mesh, ``method.gen_kwargs`` the generation
         parameters, ``train.rollout`` the engine geometry (slots /
         admit_width / harvest_width / block_size; the ``engine`` field
-        is ignored — serving is always continuous).
+        is ignored — serving is always continuous), ``train.serving``
+        the QoS/prefix/streaming section
+        (:class:`~trlx_tpu.serving.ServingConfig`).
     :param checkpoint_dir: optional trainer checkpoint directory
         (``utils/checkpoint``): the policy params are restored from the
         saved train state (optimizer state is read but discarded).
@@ -87,6 +114,7 @@ class InferenceServer:
         ``checkpoint_dir``).
     :param tokenizer: optional tokenizer for string prompts / decoded
         results (falls back to ``model.tokenizer_path``).
+    :param serving: optional dict overriding ``train.serving``.
     """
 
     def __init__(
@@ -96,6 +124,7 @@ class InferenceServer:
         params=None,
         tokenizer=None,
         seed: int = 0,
+        serving: Optional[Dict[str, Any]] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -108,6 +137,10 @@ class InferenceServer:
             validate_gen_config,
         )
         from trlx_tpu.parallel import make_mesh, make_partition_specs
+        from trlx_tpu.serving import ServingConfig
+        from trlx_tpu.serving.prefix_cache import PrefixBlockPool
+        from trlx_tpu.serving.scheduler import build_scheduler
+        from trlx_tpu.serving.streaming import StreamRouter
         from trlx_tpu.telemetry.health import HealthConfig, HealthMonitor
         from trlx_tpu.trainer.ppo_trainer import get_causal_arch
 
@@ -191,6 +224,9 @@ class InferenceServer:
         num_slots = rollout.slots or int(
             getattr(config.method, "chunk_size", 0) or train.batch_size
         )
+        self.serving_config = ServingConfig.from_dict(
+            serving if serving is not None else getattr(train, "serving", {})
+        )
 
         def apply_fn(p, input_ids, attention_mask=None, position_ids=None,
                      cache=None, cache_index=None, last_only=False):
@@ -221,19 +257,50 @@ class InferenceServer:
             mesh=self.mesh,
             param_shardings=self.param_shardings,
             with_values=True,
+            prefix_pool_blocks=self.serving_config.prefix_cache_blocks,
+            stream_taps=True,
         )
         # fold_in consumes rng without a dangling split chain (the
         # key-lineage engine's key-discard rule)
         phase_key = jax.random.fold_in(rng, 7)
         self.engine.start_phase(self.params, phase_key)
 
+        from trlx_tpu import telemetry
+
+        self._registry = telemetry.get_metrics()
+        self.scheduler = build_scheduler(
+            self.serving_config, registry=self._registry
+        )
+        self.prefix_pool = (
+            PrefixBlockPool(
+                self.serving_config.prefix_cache_blocks,
+                self.engine.block_size,
+                self.engine.n_blocks,
+            )
+            if self.serving_config.prefix_cache_blocks > 0
+            else None
+        )
+        self._router = StreamRouter(
+            maxlen=self.serving_config.stream_buffer
+        )
+        self.engine._admit_listener = self._on_admitted
+
         # generation-health watch: non-finite logprobs/values in a served
-        # group trip nan-precursor; zero events == healthy checkpoint
+        # group trip nan-precursor, per-tenant queue-wait p95 over the
+        # SLO budget trips slo-breach; zero events == healthy serving
         self.health_monitor = HealthMonitor(
             HealthConfig.from_dict({"enabled": True})
         )
+        self._requests: Dict[int, Any] = {}  # request_id -> Request
+        self._row_to_req: Dict[int, int] = {}  # engine row -> request_id
+        self._req_row: Dict[int, int] = {}  # request_id -> engine row
+        self._acquired: Dict[int, List[int]] = {}  # rid -> pool blocks
+        self._published_by_row: Dict[int, List[int]] = {}
+        self._streams: Dict[int, Any] = {}  # rid -> TokenStream
         self._results: Dict[int, Dict[str, Any]] = {}
         self._open: Dict[int, bool] = {}
+        self._next_request = itertools.count()
+        self.completion_order: List[int] = []
         self._groups_served = 0
 
     # ------------------------------ API -------------------------------- #
@@ -249,40 +316,218 @@ class InferenceServer:
             return list(self.tokenizer.encode(prompt))
         return list(prompt)
 
-    def submit(self, prompts: Sequence[Any]) -> List[int]:
-        """Enqueue prompts (strings with a tokenizer, or token-id lists /
-        arrays); returns request ids. Prompts longer than
-        ``train.seq_length`` are refused (truncation would silently serve
-        a different prompt)."""
+    def _pad_prompt(self, toks: List[int], i: int):
         Q = self.query_length
         pad_id = self.gen_config.pad_token_id
-        n = len(prompts)
-        ids = np.full((n, Q), pad_id, np.int32)
-        mask = np.zeros((n, Q), np.int32)
+        if not toks:
+            raise ValueError(f"prompt {i} is empty")
+        if len(toks) > Q:
+            raise ValueError(
+                f"prompt {i} has {len(toks)} tokens > seq_length={Q}"
+            )
+        ids = np.full((Q,), pad_id, np.int32)
+        mask = np.zeros((Q,), np.int32)
+        ids[Q - len(toks):] = toks  # left-pad, as the trainer does
+        mask[Q - len(toks):] = 1
+        return ids, mask
+
+    def submit(
+        self,
+        prompts: Sequence[Any],
+        tenant: str = DEFAULT_TENANT,
+        priority: Optional[int] = None,
+        slo_class: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        stream: bool = False,
+    ) -> List[int]:
+        """Enqueue prompts (strings with a tokenizer, or token-id lists /
+        arrays) with the QoS scheduler; returns request ids. Prompts
+        longer than ``train.seq_length`` are refused (truncation would
+        silently serve a different prompt).
+
+        ``tenant``/``priority``/``slo_class``/``deadline_ms`` type the
+        requests for admission (defaults inherit the tenant's
+        ``train.serving.tenants`` entry); ``stream=True`` opens a
+        per-request token stream (:meth:`stream`) fed per decode step.
+        """
+        from trlx_tpu import telemetry
+        from trlx_tpu.serving.scheduler import Request
+        from trlx_tpu.serving.streaming import TokenStream
+
+        tenant_cfg = self.scheduler.tenant_config(tenant)
+        prio = tenant_cfg.priority if priority is None else int(priority)
+        slo = tenant_cfg.slo_class if slo_class is None else slo_class
+        now = telemetry.monotonic()
+        # build + validate the WHOLE batch before enqueueing anything:
+        # a mid-batch refusal (over-long prompt, unadmittable cost)
+        # must not orphan earlier requests whose ids the caller never
+        # received
+        reqs = []
         for i, p in enumerate(prompts):
-            toks = self._encode(p)
-            if not toks:
-                raise ValueError(f"prompt {i} is empty")
-            if len(toks) > Q:
-                raise ValueError(
-                    f"prompt {i} has {len(toks)} tokens > seq_length={Q}"
+            ids, mask = self._pad_prompt(self._encode(p), i)
+            req = Request(
+                request_id=next(self._next_request),
+                tenant=tenant,
+                prompt_ids=ids,
+                prompt_mask=mask,
+                priority=prio,
+                slo_class=slo,
+                max_tokens=self.engine.R,
+                deadline=(
+                    now + deadline_ms / 1000.0
+                    if deadline_ms is not None
+                    else None
+                ),
+                stream=bool(stream),
+                cost=float(int(mask.sum()) + self.engine.R),
+                submitted_at=now,
+            )
+            self.scheduler.validate(req)
+            reqs.append(req)
+        rids = []
+        for req in reqs:
+            rid = req.request_id
+            self.scheduler.submit(req)
+            self._requests[rid] = req
+            self._open[rid] = True
+            if stream:
+                self._streams[rid] = TokenStream(
+                    rid,
+                    maxlen=self.serving_config.stream_buffer,
+                    pump=self._pump_once,
                 )
-            ids[i, Q - len(toks):] = toks  # left-pad, as the trainer does
-            mask[i, Q - len(toks):] = 1
+            rids.append(rid)
+        return rids
+
+    def stream(self, request_id: int):
+        """The :class:`~trlx_tpu.serving.streaming.TokenStream` iterator
+        of a ``stream=True`` request — pulls tokens per decode step,
+        pumping the serving loop as needed."""
+        s = self._streams.get(request_id)
+        if s is None:
+            raise KeyError(
+                f"request {request_id} was not submitted with stream=True"
+            )
+        return s
+
+    # --------------------------- serving pump --------------------------- #
+
+    def _on_admitted(self, rows: List[int]) -> None:
+        """Engine admit listener: newly published prefix blocks become
+        readable for later admission groups (the publishing prefill has
+        been dispatched — device order makes its writes land first)."""
+        if self.prefix_pool is None:
+            return
+        for row in rows:
+            published = self._published_by_row.pop(row, None)
+            if published:
+                self.prefix_pool.mark_ready(published)
+
+    def _engine_submit(self, batch) -> None:
+        """Move scheduler picks into the engine's admission queue."""
+        from trlx_tpu.utils.retry import retry_call
+
+        n = len(batch)
+        Q = self.query_length
+        ids = np.zeros((n, Q), np.int32)
+        mask = np.zeros((n, Q), np.int32)
+        shared_maps = publish_maps = None
+        plans = []
+        for i, req in enumerate(batch):
+            ids[i] = req.prompt_ids
+            mask[i] = req.prompt_mask
+            if self.prefix_pool is not None:
+                plan = self.prefix_pool.plan_admission(
+                    req.prompt_ids, req.prompt_mask,
+                    eligible_blocks=Q // self.engine.block_size,
+                )
+                plans.append(plan)
+        if plans:
+            shared_maps = np.stack([p.shared_map for p in plans])
+            publish_maps = np.stack([p.publish_map for p in plans])
         # admission is host-side bookkeeping, but it sits on the serving
         # request path — a transient failure (the engine.admit injection
         # site models one) retries with bounded backoff instead of
         # bouncing the request (docs/resilience.md)
-        from trlx_tpu.utils.retry import retry_call
+        try:
+            rows = retry_call(
+                lambda: self.engine.submit(
+                    ids,
+                    mask,
+                    shared_maps=shared_maps,
+                    publish_maps=publish_maps,
+                    submit_times=[req.submitted_at for req in batch],
+                ),
+                describe="inference-server admission",
+            )
+        except Exception:
+            # permanent admission failure: roll the plans back, or the
+            # acquired refcounts and never-ready publish blocks leak —
+            # pinned forever (unevictable) and breaking every later
+            # same-prefix trie walk
+            if self.prefix_pool is not None:
+                for plan in plans:
+                    if plan.acquired:
+                        self.prefix_pool.abandon(plan.acquired)
+            raise
+        for i, (row, req) in enumerate(zip(rows, batch)):
+            self._row_to_req[row] = req.request_id
+            self._req_row[req.request_id] = row
+            if plans:
+                if plans[i].acquired:
+                    self._acquired[req.request_id] = plans[i].acquired
+                if plans[i].published:
+                    self._published_by_row[row] = plans[i].published
+            if req.stream:
+                s = self._streams.get(req.request_id)
+                if s is not None:
+                    self._router.attach(row, s)
 
-        rows = retry_call(
-            lambda: self.engine.submit(ids, mask),
-            describe="inference-server admission",
+    def _submit_placeholders(self, n: int) -> None:
+        """Pad the engine queue with ``n`` release-on-admission rows so
+        the final partial harvest group fills WITHOUT decoding dummy
+        rollouts to their full token budget (each placeholder costs one
+        decode step — the PR-8 padding waste, fixed)."""
+        Q = self.query_length
+        ids = np.full((n, Q), self.gen_config.pad_token_id, np.int32)
+        mask = np.zeros((n, Q), np.int32)
+        ids[:, Q - 1] = self.gen_config.pad_token_id
+        mask[:, Q - 1] = 1
+        self.engine.submit(ids, mask, release=True)
+
+    def _pump_once(self) -> bool:
+        """One serving iteration: feed the engine from the scheduler,
+        advance decode a step, land any harvested groups. Returns
+        whether anything progressed.
+
+        When the scheduler has nothing more to feed and the in-flight
+        rows cannot fill the last fixed-width harvest group, the pump
+        pads with release-on-admission placeholders — so a lone
+        streaming request (or a trailing partial group) drains without
+        waiting for traffic that may never come."""
+        engine = self.engine
+        free = engine.free_capacity
+        if free > 0 and self.scheduler.has_work():
+            batch = self.scheduler.next_batch(free)
+            if batch:
+                self._engine_submit(batch)
+        Hw = engine.harvest_width
+        if (
+            not self.scheduler.has_work()
+            and engine.pending
+            and engine.pending % Hw
+        ):
+            self._submit_placeholders(Hw - engine.pending % Hw)
+        # tap cost is per-step host fetches: only pay while someone is
+        # actually streaming
+        engine.token_sink = (
+            self._router.on_tokens if self._router.active else None
         )
-        for r in rows:
-            self._open[r] = True
-        self._last_prompt = (ids[-1].copy(), mask[-1].copy())
-        return rows
+        busy_before = engine.pending
+        groups = engine.pump()
+        for group in groups:
+            self._land_group(group)
+        return bool(groups) or busy_before > 0
 
     def _observe_group(self, group) -> None:
         lp = np.asarray(group["logprobs"])
@@ -294,65 +539,81 @@ class InferenceServer:
             "health/logprob_min": float(picked.min()),
             "health/value_mean": float(vals[m].mean() if m.any() else 0.0),
         }
+        # per-tenant SLO watch: measured queue-wait p95 over the class
+        # budget; a ratio > 1 trips the slo-breach detector
+        row.update(self.scheduler.slo_ratio_rows())
         self.health_monitor.observe(row, step=self._groups_served)
         self._groups_served += 1
 
-    def flush(self) -> int:
-        """Drive the engine until every submitted request has completed;
-        returns the number of newly completed requests. The queue is
-        padded to a whole number of harvest groups with duplicate rows
-        (discarded on harvest) so shapes stay fixed."""
+    def _land_group(self, group) -> None:
         import jax
 
         engine = self.engine
-        pending_rows = [r for r, open_ in self._open.items() if open_]
-        if not pending_rows:
-            return 0
-        Hw = engine.harvest_width
-        n = engine.pending
-        target = ((n + Hw - 1) // Hw) * Hw
-        if target > n:
-            # pad the queue to a whole number of fixed-shape harvest
-            # groups with copies of the last real prompt; their results
-            # are discarded on harvest
-            fill_ids, fill_mask = self._last_prompt
-            pad_rows = engine.submit(
-                np.repeat(fill_ids[None, :], target - n, axis=0),
-                np.repeat(fill_mask[None, :], target - n, axis=0),
-            )
-        else:
-            pad_rows = []
-        pad_set = set(pad_rows)
-        completed = 0
-        from trlx_tpu import telemetry
+        toks = np.asarray(jax.device_get(group["tokens"]))
+        mask = np.asarray(jax.device_get(group["response_mask"]))
+        self._observe_group(group)
+        for j, row in enumerate(group["rows"]):
+            timing = engine.pop_request_timing(row)
+            rid = self._row_to_req.pop(row, None)
+            self._published_by_row.pop(row, None)
+            # refcounts drop for EVERY harvested row with a plan — also
+            # rows whose request was closed early (pop_result mid-
+            # flight), which would otherwise pin pool blocks forever
+            if rid is not None:
+                acquired = self._acquired.pop(rid, None)
+                if acquired and self.prefix_pool is not None:
+                    self.prefix_pool.release(acquired)
+            # the router entry is keyed by ROW and must go even for an
+            # early-closed request (pop_result mid-flight) — a leaked
+            # not-closed stream would keep the engine's token tap (two
+            # extra device fetches per decode step) on forever
+            stream = self._router.pop(row)
+            if stream is not None:
+                stream.close()
+            if rid is None or not self._open.get(rid):
+                continue  # placeholder / already-closed row
+            req = self._requests[rid]
+            length = int(mask[j].sum())
+            if timing is not None:
+                observe_request_metrics(
+                    self._registry, timing, length, tenant=req.tenant
+                )
+            out: Dict[str, Any] = {
+                "tokens": toks[j, :length].tolist(),
+                "length": length,
+                "tenant": req.tenant,
+            }
+            if self.tokenizer is not None:
+                out["text"] = self.tokenizer.decode(
+                    out["tokens"], skip_special_tokens=True
+                )
+            self._results[rid] = out
+            self._open[rid] = False
+            self.completion_order.append(rid)
 
-        registry = telemetry.get_metrics()
-        for group in engine.drive(target):
-            toks = np.asarray(jax.device_get(group["tokens"]))
-            mask = np.asarray(jax.device_get(group["response_mask"]))
-            self._observe_group(group)
-            for j, r in enumerate(group["rows"]):
-                timing = engine.pop_request_timing(r)
-                if r in pad_set or r not in self._open:
-                    continue
-                length = int(mask[j].sum())
-                # per-request latency histograms through the shared
-                # metrics registry (queue wait, prefill, TTFT,
-                # per-token decode, e2e) — docs/observability.md
-                if timing is not None:
-                    observe_request_metrics(registry, timing, length)
-                out: Dict[str, Any] = {
-                    "tokens": toks[j, :length].tolist(),
-                    "length": length,
-                }
-                if self.tokenizer is not None:
-                    out["text"] = self.tokenizer.decode(
-                        out["tokens"], skip_special_tokens=True
+    def flush(self) -> int:
+        """Drive the serving loop until every submitted request has
+        completed; returns the number of newly completed requests.
+        Partial final harvest groups fill with release-on-admission
+        placeholders (one decode step each) instead of fully-decoded
+        dummy rows."""
+        open_before = [r for r, o in self._open.items() if o]
+        if not open_before:
+            return 0
+        while any(self._open.get(r) for r in open_before):
+            progressed = self._pump_once()
+            if not progressed:
+                if self.scheduler.has_work():
+                    # quota-throttled tenants: wait for bucket refill
+                    time.sleep(0.002)
+                else:
+                    raise RuntimeError(
+                        "serving pump stalled with open requests but "
+                        "nothing pending — request bookkeeping bug"
                     )
-                self._results[r] = out
-                self._open[r] = False
-                completed += 1
-        return completed
+        return sum(
+            1 for r in open_before if not self._open.get(r)
+        )
 
     def poll(self, request_id: int) -> Optional[Dict[str, Any]]:
         """Completed result for ``request_id`` (None while in flight);
@@ -360,7 +621,15 @@ class InferenceServer:
         return self._results.get(request_id)
 
     def pop_result(self, request_id: int) -> Optional[Dict[str, Any]]:
+        # an in-flight streaming request closes its stream NOW (the tap
+        # stops paying per-step fetches once no stream is live); the
+        # row-keyed router entry itself is popped at harvest
+        row = self._req_row.pop(request_id, None)
+        if row is not None:
+            self._router.close(row)
         self._open.pop(request_id, None)
+        self._requests.pop(request_id, None)
+        self._streams.pop(request_id, None)
         return self._results.pop(request_id, None)
 
     def wait(self, request_ids: Sequence[int]) -> Dict[int, Dict[str, Any]]:
@@ -376,23 +645,31 @@ class InferenceServer:
             )
         return {r: self.pop_result(r) for r in request_ids}
 
-    def generate(self, prompts: Sequence[Any]) -> List[Dict[str, Any]]:
+    def generate(self, prompts: Sequence[Any], **submit_kwargs
+                 ) -> List[Dict[str, Any]]:
         """Blocking convenience: submit + wait, results in prompt order."""
-        rids = self.submit(prompts)
+        rids = self.submit(prompts, **submit_kwargs)
         done = self.wait(rids)
         return [done[r] for r in rids]
 
     def stats(self) -> Dict[str, float]:
-        """Engine occupancy/throughput counters (cumulative this phase)."""
-        return self.engine.stats.to_dict()
+        """Engine occupancy/throughput counters (cumulative this phase)
+        plus scheduler and prefix-pool accounting."""
+        out = self.engine.stats.to_dict()
+        out["scheduler/admitted"] = float(self.scheduler.admitted)
+        out["scheduler/pending"] = float(self.scheduler.pending)
+        out["scheduler/throttled_rounds"] = float(
+            self.scheduler.throttled_rounds
+        )
+        if self.prefix_pool is not None:
+            out.update(self.prefix_pool.stats())
+        return out
 
     def metrics(self) -> Dict[str, Any]:
         """The ``serve/*`` slice of the metrics-registry snapshot: the
         per-request latency histograms (summaries) and counters this
-        process accumulated."""
-        from trlx_tpu import telemetry
-
-        snap = telemetry.get_metrics().snapshot()
+        process accumulated — aggregate AND tenant-labeled keys."""
+        snap = self._registry.snapshot()
         out: Dict[str, Any] = {}
         for section in ("counters", "gauges"):
             for name, value in snap.get(section, {}).items():

@@ -1,0 +1,116 @@
+"""Streaming token output: per-request bounded queues + iterators.
+
+The engine's ``stream_taps`` decode step returns each step's (token,
+live) vectors; :class:`StreamRouter` fans them out into per-request
+:class:`TokenStream` queues the moment they exist — time-to-first-token
+decouples from harvest-group completion (the ``serve/ttft_ms``
+histogram measures the difference; docs/serving.md "Streaming").
+
+Single-process contract: the serving loop and the consumer interleave
+on one thread (the iterator *pumps the engine* when its queue is
+empty), so a ``stream=True`` submit works without any background
+machinery. The queues are still thread-safe deques, so a
+driver-thread + consumer-thread deployment works unchanged — a full
+queue drops the OLDEST buffered token and counts the overflow
+(``overflows`` on the stream), never blocks the decode loop.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Dict, Iterator, List, Optional
+
+
+class TokenStream:
+    """Bounded per-request token queue with iterator access.
+
+    ``__next__`` returns buffered tokens first; on an empty buffer it
+    calls the ``pump`` callable (one serving-loop iteration) until a
+    token lands or the stream closes. Closed + drained ⇒
+    ``StopIteration``.
+    """
+
+    def __init__(
+        self,
+        request_id: int,
+        maxlen: int = 1024,
+        pump: Optional[Callable[[], object]] = None,
+    ):
+        self.request_id = request_id
+        self._buf: "deque[int]" = deque(maxlen=max(1, int(maxlen)))
+        self._pump = pump
+        self.closed = False
+        self.overflows = 0  # tokens dropped oldest-first on a full queue
+        self.emitted = 0
+
+    def push(self, token: int) -> None:
+        if len(self._buf) == self._buf.maxlen:
+            self.overflows += 1
+        self._buf.append(int(token))
+        self.emitted += 1
+
+    def close(self) -> None:
+        self.closed = True
+
+    def __iter__(self) -> Iterator[int]:
+        return self
+
+    def __next__(self) -> int:
+        while True:
+            if self._buf:
+                return self._buf.popleft()
+            if self.closed:
+                raise StopIteration
+            if self._pump is None:
+                raise StopIteration
+            if not self._pump():
+                # no progress (e.g. this request is quota-throttled and
+                # nothing is decoding): yield the CPU while the bucket
+                # refills instead of busy-spinning the serving loop
+                time.sleep(0.002)
+
+    def drain(self) -> List[int]:
+        """Everything currently buffered, without pumping."""
+        out = list(self._buf)
+        self._buf.clear()
+        return out
+
+
+class StreamRouter:
+    """Row-index → :class:`TokenStream` fan-out; the engine's
+    ``token_sink``."""
+
+    def __init__(self, maxlen: int = 1024):
+        self.maxlen = int(maxlen)
+        self._streams: Dict[int, TokenStream] = {}
+
+    def attach(self, row: int, stream: TokenStream) -> None:
+        """Bind an already-open stream (created at request submit, before
+        its engine row existed) to its row."""
+        self._streams[row] = stream
+
+    def get(self, row: int) -> Optional[TokenStream]:
+        return self._streams.get(row)
+
+    @property
+    def active(self) -> int:
+        return sum(
+            1 for s in self._streams.values() if not s.closed
+        )
+
+    def on_tokens(self, emitted: Dict[int, int]) -> None:
+        """Engine token-sink callback: ``{row: token}`` for this decode
+        step's live emissions."""
+        for row, token in emitted.items():
+            stream = self._streams.get(row)
+            if stream is not None and not stream.closed:
+                stream.push(token)
+
+    def close(self, row: int) -> None:
+        stream = self._streams.get(row)
+        if stream is not None:
+            stream.close()
+
+    def pop(self, row: int) -> Optional[TokenStream]:
+        return self._streams.pop(row, None)

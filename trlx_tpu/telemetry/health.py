@@ -122,6 +122,18 @@ DEFAULT_DETECTORS: Dict[str, Dict[str, Any]] = {
         series=("async/staleness",),
         kind="above", severity="error", threshold=1e9,
     ),
+    # serving-tier SLO watch (docs/serving.md): the serving loop feeds
+    # one row per harvest group with the measured queue-wait p95 over
+    # each tenant's SLO-class budget, keyed per tenant
+    # (serve/slo_queue_wait_ratio[tenant=acme] — matched by PREFIX
+    # since tenant names are dynamic). A ratio > 1 means that tenant's
+    # requests waited longer than its class promises; warning severity
+    # (a breach wants scheduling/capacity attention, not an abort), and
+    # it flows through the same event sinks as every detector.
+    "slo-breach": dict(
+        series=(), series_prefix=("serve/slo_queue_wait_ratio",),
+        kind="above", severity="warning", threshold=1.0,
+    ),
 }
 
 
@@ -184,7 +196,9 @@ class HealthConfig:
             # same loudness as the top-level keys: a tuning typo
             # ("zmx") silently keeping the old threshold is worse than
             # a refusal. series/kind are structural, not tunable.
-            tunable = set(DEFAULT_DETECTORS[did]) - {"series", "kind"}
+            tunable = set(DEFAULT_DETECTORS[did]) - {
+                "series", "series_prefix", "kind",
+            }
             unknown_params = set(overrides) - tunable
             if unknown_params:
                 raise ValueError(
@@ -512,15 +526,22 @@ class HealthMonitor:
                 del values[key]
 
         # evaluate every detector against every candidate series present
-        # (pre-update stats = the baseline the new value is judged by)
+        # (pre-update stats = the baseline the new value is judged by);
+        # prefix-series detectors (slo-breach) match dynamically-named
+        # keys like serve/slo_queue_wait_ratio[tenant=...]
         for did, spec in self._specs.items():
             if spec["kind"] == "nonfinite":
                 continue
-            for key in spec["series"]:
-                if key in values:
-                    self._evaluate(
-                        events, did, spec, key, values[key], step, phase
-                    )
+            candidates = [k for k in spec["series"] if k in values]
+            for prefix in spec.get("series_prefix", ()):
+                candidates.extend(
+                    k for k in sorted(values)
+                    if k.startswith(prefix) and k not in candidates
+                )
+            for key in candidates:
+                self._evaluate(
+                    events, did, spec, key, values[key], step, phase
+                )
 
         # then advance each series exactly once
         for key, v in values.items():
@@ -561,7 +582,7 @@ def detector_defaults_table() -> List[Tuple[str, str, str, str]]:
     for did, spec in sorted(DEFAULT_DETECTORS.items()):
         params = ", ".join(
             f"{k}={v}" for k, v in sorted(spec.items())
-            if k not in ("series", "kind", "severity")
+            if k not in ("series", "series_prefix", "kind", "severity")
         )
         rows.append((did, spec["kind"], spec["severity"], params))
     return rows
