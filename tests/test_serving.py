@@ -491,6 +491,89 @@ def test_prefix_sharing_hits_on_served_traffic(server):
     assert server.stats()["engine/prefix_hit_rate"] > 0
 
 
+def test_request_traces_complete_and_sum_to_e2e(server):
+    """Tentpole acceptance at the server: every completed request —
+    streamed and non-streamed — emits ONE closed, root-parented span
+    chain whose disjoint critical-path stages tile the root span
+    exactly and (minus the post-harvest delivery stage) tie out to the
+    request's serve/e2e_ms histogram observation within 5%. Padding
+    placeholders emit NO chain — they are rows, not requests."""
+    from trlx_tpu import telemetry
+    from trlx_tpu.telemetry.request_trace import ROOT, STAGES
+
+    with telemetry.scoped_tracer() as tr:
+        rids = server.submit(_full_prompts(server, 2, seed=21))
+        srid = server.submit(
+            _full_prompts(server, 1, seed=22), stream=True
+        )[0]
+        streamed = list(server.stream(srid))
+        server.flush()
+        results = server.wait(rids + [srid])
+        spans = tr.spans()
+    assert all(results[r]["length"] >= 1 for r in rids + [srid])
+    assert streamed == results[srid]["tokens"]
+    roots = {
+        s.attrs["request_id"]: s for s in spans if s.name == ROOT
+    }
+    # exactly one chain per request; placeholders contribute none
+    assert sorted(roots) == sorted(rids + [srid])
+    by_trace = {}
+    for s in spans:
+        tid = s.attrs.get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(s)
+    for rid, root in roots.items():
+        chain = by_trace[root.attrs["trace_id"]]
+        assert all(s.end >= s.start for s in chain)  # closed
+        stages = [s for s in chain if s.name in STAGES]
+        assert all(s.parent == root.index for s in stages)  # parented
+        stage_sum = sum(s.duration_ms for s in stages)
+        assert stage_sum == pytest.approx(root.duration_ms, rel=0.01)
+        deliver = sum(
+            s.duration_ms for s in stages if s.name == "serve/deliver"
+        )
+        # stage sum ≈ the serve/e2e_ms observation (carried as a root
+        # attr so the tie-out needs no histogram join)
+        assert stage_sum - deliver == pytest.approx(
+            root.attrs["e2e_ms"], rel=0.05, abs=0.5
+        )
+        # decode cadence rode along (the bubble estimator's feed)
+        decode = next(s for s in stages if s.name == "serve/decode")
+        assert decode.attrs.get("steps", 0) >= 1
+        assert len(decode.attrs["step_offsets_ms"]) == decode.attrs["steps"]
+    # the streamed request additionally carries its delivery overlay
+    s_chain = by_trace[roots[srid].attrs["trace_id"]]
+    assert any(s.name == "serve/stream" for s in s_chain)
+    assert roots[srid].attrs["stream"] is True
+
+
+def test_request_trace_closes_for_early_popped_stream(server):
+    """An abandoned request (pop_result mid-flight) still decodes to
+    harvest — its span chain must close there too, flagged abandoned,
+    or trace completeness silently excludes exactly the requests an
+    operator most wants to see."""
+    from trlx_tpu import telemetry
+    from trlx_tpu.telemetry.request_trace import ROOT
+
+    with telemetry.scoped_tracer() as tr:
+        rid = server.submit(
+            _full_prompts(server, 1, seed=23), stream=True
+        )[0]
+        server._pump_once()  # admitted
+        assert server.pop_result(rid) is None  # abandoned mid-flight
+        other = server.submit(_full_prompts(server, 1, seed=24))
+        server.flush()
+        server.wait(other)
+        roots = {
+            s.attrs["request_id"]: s
+            for s in tr.spans()
+            if s.name == ROOT
+        }
+    assert rid in roots and roots[rid].attrs["status"] == "abandoned"
+    assert other[0] in roots and roots[other[0]].attrs["status"] == "ok"
+    assert server._trace_reqs == {}  # retention reclaimed at harvest
+
+
 # ----------------------- engine-level (run last) ------------------------ #
 
 

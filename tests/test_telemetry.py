@@ -95,6 +95,80 @@ def test_ring_buffer_bounds_and_drop_counter():
     assert tracer.dropped == 3
 
 
+def test_ring_size_env_override(monkeypatch):
+    from trlx_tpu.telemetry.tracer import DEFAULT_RING_SIZE, env_ring_size
+
+    monkeypatch.delenv("TRLX_TELEMETRY_RING", raising=False)
+    assert env_ring_size() == DEFAULT_RING_SIZE
+    monkeypatch.setenv("TRLX_TELEMETRY_RING", "128")
+    assert env_ring_size() == 128
+    # malformed/nonpositive values fall back — a typo must not kill the
+    # run that was trying to observe itself
+    monkeypatch.setenv("TRLX_TELEMETRY_RING", "bogus")
+    assert env_ring_size() == DEFAULT_RING_SIZE
+    monkeypatch.setenv("TRLX_TELEMETRY_RING", "0")
+    assert env_ring_size() == DEFAULT_RING_SIZE
+
+
+def test_configure_from_dict_ring_size(monkeypatch):
+    from trlx_tpu import telemetry
+
+    monkeypatch.delenv("TRLX_TELEMETRY_RING", raising=False)
+    tracer = telemetry.get_tracer()
+    prev = tracer._records.maxlen
+    try:
+        assert telemetry.configure_from_dict({"ring_size": 32}) is tracer
+        assert tracer._records.maxlen == 32
+        # an explicit env override outranks the YAML
+        monkeypatch.setenv("TRLX_TELEMETRY_RING", "64")
+        telemetry.configure_from_dict({"ring_size": 16})
+        assert tracer._records.maxlen == 32
+        # ...but a MALFORMED env value must not ALSO block the YAML —
+        # validity decides precedence, not mere presence
+        monkeypatch.setenv("TRLX_TELEMETRY_RING", "64k")
+        telemetry.configure_from_dict({"ring_size": 48})
+        assert tracer._records.maxlen == 48
+        monkeypatch.delenv("TRLX_TELEMETRY_RING")
+        with pytest.raises(ValueError, match="Unknown train.telemetry"):
+            telemetry.configure_from_dict({"ringsize": 8})
+        with pytest.raises(ValueError, match=">= 1"):
+            telemetry.configure_from_dict({"ring_size": 0})
+        # empty/None section: untouched
+        telemetry.configure_from_dict(None)
+        assert tracer._records.maxlen == 48
+    finally:
+        telemetry.configure(max_records=prev)
+
+
+def test_tracer_record_external_spans():
+    """Externally-stamped spans (the per-request trace path): explicit
+    start/end, explicit parenting, no thread-stack participation, ring
+    accounting like any other span."""
+    from trlx_tpu.telemetry import Span
+
+    tracer = _fresh_tracer(max_records=4)
+    root = Span("serve/request")
+    root.start, root.end = 5.0, 6.0
+    ix = tracer.record(root)
+    child = Span("serve/queue")
+    child.start, child.end = 5.0, 5.5
+    tracer.record(child, parent=ix)
+    assert child.parent == ix
+    assert tracer.ancestors(child) == [root]
+    # the thread stack is untouched: a live context-manager span is
+    # still a root
+    with tracer.span("live") as sp:
+        pass
+    assert sp.parent is None
+    # disabled tracer records nothing
+    tracer.enabled = False
+    ghost = Span("serve/request")
+    ghost.start, ghost.end = 7.0, 8.0
+    assert tracer.record(ghost) is None
+    tracer.enabled = True
+    assert len([s for s in tracer.spans() if s.name == "serve/request"]) == 1
+
+
 def test_chrome_trace_export_roundtrip(tmp_path):
     from trlx_tpu.telemetry import chrome_trace_from_jsonl, export_chrome_jsonl
 

@@ -1,0 +1,447 @@
+"""Per-request distributed tracing + the --trace-report analyzer.
+
+Host-only, no jax: the span-chain emitter (stage contiguity, quota-hold
+splitting, mark clamping, tenant tracks), the critical-path analyzer
+over synthetic span logs (residual self-check, per-tenant/SLO tail
+breakdown, the decode-cadence bubble estimator's gap-free-zero
+contract), the CLI plumbing, and the two triage-surface satellites —
+``--inspect`` per-tenant histogram rows and ``--compare`` over
+tenant-labeled flat keys. The end-to-end traced serving path (real
+engine, real marks) is pinned in tests/test_serving.py; the per-PR CI
+``serving-smoke`` job runs the analyzer over the real mt-smoke log.
+"""
+
+import json
+
+import pytest
+
+from trlx_tpu.telemetry.request_trace import (
+    REQUEST_TRACK_BASE,
+    ROOT,
+    STAGES,
+    emit_request_trace,
+    mint_trace_id,
+    request_track,
+)
+from trlx_tpu.telemetry.tracer import Span, Tracer, export_chrome_jsonl
+from trlx_tpu.telemetry.trace_report import (
+    build_requests,
+    decode_bubbles,
+    load_request_spans,
+    render_report,
+    report_json,
+    tenant_tail_breakdown,
+)
+
+
+# ------------------------------ emitter -------------------------------- #
+
+
+def _marks(
+    submitted=10.0, admitted=10.2, first=10.25, done=10.45, completed=10.5
+):
+    return {
+        "submitted": submitted,
+        "admitted": admitted,
+        "first_token": first,
+        "done": done,
+        "completed": completed,
+    }
+
+
+def _timing(marks):
+    ms = 1000.0
+    return {
+        "queue_wait_ms": (marks["admitted"] - marks["submitted"]) * ms,
+        "prefill_ms": (marks["first_token"] - marks["admitted"]) * ms,
+        "ttft_ms": (marks["first_token"] - marks["submitted"]) * ms,
+        "decode_ms": (marks["completed"] - marks["first_token"]) * ms,
+        "e2e_ms": (marks["completed"] - marks["submitted"]) * ms,
+    }
+
+
+def _emit(tracer, rid=1, tenant="gold", **kwargs):
+    marks = kwargs.pop("marks", _marks())
+    defaults = dict(
+        trace_id=mint_trace_id(rid),
+        request_id=rid,
+        tenant=tenant,
+        priority=5,
+        slo_class="interactive",
+        streamed=False,
+        tokens=4,
+        marks=marks,
+        timing=_timing(marks),
+        delivered=marks["completed"] + 0.001,
+    )
+    defaults.update(kwargs)
+    return emit_request_trace(tracer, **defaults)
+
+
+def test_emit_chain_is_parented_contiguous_and_sums_to_root():
+    tracer = Tracer(enabled=True)
+    root_ix = _emit(tracer, rid=3)
+    spans = tracer.spans()
+    root = next(s for s in spans if s.name == ROOT)
+    assert root.index == root_ix
+    assert root.attrs["tenant"] == "gold"
+    assert root.attrs["slo_class"] == "interactive"
+    assert root.attrs["priority"] == 5
+    assert root.attrs["status"] == "ok"
+    children = [s for s in spans if s.name in STAGES]
+    assert all(c.parent == root_ix for c in children)
+    # disjoint + contiguous: the stages tile the root exactly
+    stage_sum = sum(c.duration_ms for c in children)
+    assert stage_sum == pytest.approx(root.duration_ms, rel=1e-6)
+    # chronological tiling: each stage starts where the previous ended
+    ordered = sorted(children, key=lambda s: s.start)
+    assert ordered[0].start == root.start
+    for a, b in zip(ordered, ordered[1:]):
+        assert a.end == pytest.approx(b.start)
+    assert ordered[-1].end == pytest.approx(root.end)
+    # every span of the request rides the tenant-named track
+    tid, tname = request_track(3, "gold")
+    assert tid >= REQUEST_TRACK_BASE
+    assert all(s.thread_id == tid for s in spans)
+    assert all(s.thread_name == "tenant:gold" for s in spans)
+
+
+def test_emit_quota_hold_stage_present_when_blocked():
+    tracer = Tracer(enabled=True)
+    marks = _marks()
+    _emit(
+        tracer,
+        marks=marks,
+        quota_blocked_at=marks["submitted"] + 0.05,
+        picked_at=marks["submitted"] + 0.15,
+    )
+    by_name = {}
+    for s in tracer.spans():
+        by_name.setdefault(s.name, []).append(s)
+    hold = by_name["serve/quota_hold"][0]
+    assert hold.duration_ms == pytest.approx(100.0)
+    # the queue stage splits around the hold (pre- and post-quota legs)
+    assert len(by_name["serve/queue"]) == 2
+    root = by_name[ROOT][0]
+    stage_sum = sum(
+        s.duration_ms
+        for s in tracer.spans()
+        if s.name in STAGES
+    )
+    assert stage_sum == pytest.approx(root.duration_ms, rel=1e-6)
+
+
+def test_emit_clamps_inverted_marks_nonnegative():
+    tracer = Tracer(enabled=True)
+    marks = _marks()
+    marks["first_token"] = marks["admitted"] - 0.5  # host-stamp inversion
+    _emit(tracer, marks=marks)
+    assert all(s.end >= s.start for s in tracer.spans())
+
+
+def test_emit_abandoned_status_survives_chrome_export():
+    # chrome_trace_events writes args["status"] from the SPAN field —
+    # the root AND the deliver child must carry it there, or exported
+    # logs show "ok" for abandoned deliveries
+    from trlx_tpu.telemetry.tracer import chrome_trace_events
+
+    tracer = Tracer(enabled=True)
+    _emit(tracer, status="abandoned")
+    events = {
+        e["name"]: e
+        for e in chrome_trace_events(tracer.spans())
+        if e.get("ph") == "X"
+    }
+    assert events[ROOT]["args"]["status"] == "abandoned"
+    assert events["serve/deliver"]["args"]["status"] == "abandoned"
+
+
+def test_emit_disabled_tracer_records_nothing():
+    tracer = Tracer(enabled=False)
+    assert _emit(tracer) is None
+    tracer.enabled = True
+    assert tracer.spans() == []
+
+
+def test_emit_decode_segments_and_stream_overlay():
+    tracer = Tracer(enabled=True)
+    marks = _marks()
+    first = marks["first_token"]
+    # 4 decode steps; an admission (epoch bump) after step 2
+    step_times = [first + 0.01 * i for i in range(1, 5)]
+    step_epochs = [3, 3, 4, 4]
+    _emit(
+        tracer,
+        streamed=True,
+        marks=marks,
+        step_times=step_times,
+        step_epochs=step_epochs,
+        stream_window=(first + 0.011, marks["completed"]),
+    )
+    spans = {s.name: s for s in tracer.spans()}
+    decode = spans["serve/decode"]
+    assert decode.attrs["steps"] == 4
+    assert len(decode.attrs["step_offsets_ms"]) == 4
+    segs = [s for s in tracer.spans() if s.name == "serve/decode_segment"]
+    assert len(segs) == 2  # split at the interleaved admission
+    assert all(s.parent == decode.index for s in segs)
+    assert segs[0].attrs["steps"] == 2 and segs[1].attrs["steps"] == 2
+    assert "serve/stream" in spans
+
+
+# ------------------------- analyzer (synthetic) ------------------------- #
+
+
+def _synthetic_log(tmp_path, gap_after_step=None):
+    """A two-tenant span log via the real emitter + exporter: gold is
+    decode-dominated, bronze queue-dominated. ``gap_after_step`` opens
+    one outsized inter-step gap in gold's decode cadence (the bubble
+    the estimator must attribute); None keeps cadence uniform (bubble
+    must be exactly zero)."""
+    tracer = Tracer(enabled=True)
+    # gold: short queue, long decode, uniform 10ms cadence
+    marks = _marks(
+        submitted=1.0, admitted=1.01, first=1.02, done=1.10, completed=1.11
+    )
+    step = 0.010
+    times, t = [], 1.02
+    for i in range(8):
+        t += step
+        if gap_after_step is not None and i == gap_after_step:
+            t += 0.040  # one 4-step admission stall
+        times.append(t)
+    _emit(
+        tracer,
+        rid=1,
+        tenant="gold",
+        marks=marks,
+        step_times=times,
+        step_epochs=[1] * len(times),
+    )
+    # bronze: long queue (quota hold), short decode
+    marks_b = _marks(
+        submitted=1.0, admitted=2.0, first=2.01, done=2.05, completed=2.06
+    )
+    _emit(
+        tracer,
+        rid=2,
+        tenant="bronze",
+        slo_class="standard",
+        marks=marks_b,
+        quota_blocked_at=1.2,
+        picked_at=1.99,
+        step_times=[2.01 + step * i for i in range(1, 5)],
+        step_epochs=[2] * 4,
+    )
+    path = tmp_path / "spans.jsonl"
+    export_chrome_jsonl(str(path), tracer.spans())
+    return str(path)
+
+
+def test_report_residual_zero_and_tenant_tails(tmp_path):
+    path = _synthetic_log(tmp_path)
+    rep = report_json(path)
+    assert rep["n_requests"] == 2 and rep["n_complete"] == 2
+    assert rep["max_residual_pct"] < 5.0
+    assert rep["tenants"]["gold"]["p95_dominant_stage"] == "serve/decode"
+    assert rep["tenants"]["bronze"]["p95_dominant_stage"] in (
+        "serve/queue",
+        "serve/quota_hold",
+    )
+    assert rep["slo_classes"]["standard"]["count"] == 1
+    rendered = render_report(path)
+    assert "critical path per request" in rendered
+    assert "per-tenant tail breakdown" in rendered
+    assert "decode-cadence bubbles" in rendered
+
+
+def test_bubble_estimator_zero_on_gap_free_trace(tmp_path):
+    rep = report_json(_synthetic_log(tmp_path))
+    gold = next(
+        r
+        for r in rep["bubbles"]["requests"]
+        if r["tenant"] == "gold"
+    )
+    # uniform cadence: every gap equals the median — bubble exactly 0
+    assert gold["bubble_ms"] == 0.0
+    assert rep["bubbles"]["median_step_ms"] == pytest.approx(10.0)
+
+
+def test_bubble_estimator_attributes_admission_stall(tmp_path):
+    rep = report_json(_synthetic_log(tmp_path, gap_after_step=3))
+    gold = next(
+        r
+        for r in rep["bubbles"]["requests"]
+        if r["tenant"] == "gold"
+    )
+    # the planted 40ms stall shows as ~40ms excess over the 10ms median
+    assert gold["max_gap_ms"] == pytest.approx(50.0, abs=1.0)
+    assert gold["bubble_ms"] == pytest.approx(40.0, abs=1.0)
+    assert rep["bubbles"]["total_bubble_ms"] >= gold["bubble_ms"]
+
+
+def test_incomplete_chain_is_reported_not_dropped(tmp_path):
+    # stage spans whose root was evicted from the ring: the analyzer
+    # must surface the truncation, never silently skip the request
+    tracer = Tracer(enabled=True)
+    orphan = Span("serve/queue", {"trace_id": "req-dead-1"})
+    orphan.start, orphan.end = 1.0, 1.5
+    tracer.record(orphan)
+    path = tmp_path / "spans.jsonl"
+    export_chrome_jsonl(str(path), tracer.spans())
+    views = build_requests(load_request_spans(str(path)))
+    assert len(views) == 1 and not views[0]["complete"]
+    rep = render_report(str(path))
+    assert "no root span" in rep and "WARNING" in rep
+
+
+def test_trace_report_cli(tmp_path, capsys):
+    from trlx_tpu.telemetry.__main__ import main
+
+    path = _synthetic_log(tmp_path)
+    assert main(["--trace-report", path]) == 0
+    assert "critical path per request" in capsys.readouterr().out
+    assert main(["--trace-report", path, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["n_complete"] == 2
+    assert main(["--trace-report", str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_mint_trace_id_unique_across_servers_in_one_process():
+    # each InferenceServer counts request_ids from 0 — the mint sequence
+    # must keep two servers' ids distinct or the analyzer merges their
+    # chains into one corrupted per-request view
+    a = mint_trace_id(0)
+    b = mint_trace_id(0)
+    assert a != b
+    assert a.split("-")[-1] == b.split("-")[-1] == "0"
+
+
+def test_engine_step_log_pruned_as_requests_pop():
+    """The cadence log is bounded by the in-flight window, not the
+    server's lifetime: entries below every un-popped request's admit
+    window drop, and absolute admit/done indices stay valid through
+    the pruning."""
+    from trlx_tpu.inference.engine import ContinuousBatchingEngine
+
+    eng = object.__new__(ContinuousBatchingEngine)
+    eng.trace_requests = True
+    eng._step_base = 0
+    eng._step_log = [(float(i), 0) for i in range(10)]
+    eng._req_times = {
+        1: {"submitted": 0.0, "admitted": 0.1, "first_token": 0.2,
+            "completed": 1.0, "admit_step": 0, "done_step": 4},
+        2: {"submitted": 0.0, "admitted": 0.5, "first_token": 0.6,
+            "completed": 1.0, "admit_step": 6, "done_step": 10},
+    }
+    eng._prune_step_log()
+    assert eng._step_base == 0  # row 1 still pins the floor
+    rec1 = eng.pop_request_record(1)
+    assert rec1["step_times"] == [0.0, 1.0, 2.0, 3.0]
+    eng._prune_step_log()
+    assert eng._step_base == 6 and len(eng._step_log) == 4
+    rec2 = eng.pop_request_record(2)  # absolute indices survive pruning
+    assert rec2["step_times"] == [6.0, 7.0, 8.0, 9.0]
+    eng._prune_step_log()
+    assert eng._step_log == [] and eng._step_base == 10
+
+
+# --------------------- triage-surface satellites ----------------------- #
+
+
+def test_inspect_renders_per_tenant_histogram_rows():
+    from trlx_tpu.telemetry.flight_recorder import inspect_dump
+
+    payload = {
+        "schema_version": 1,
+        "reason": "demand",
+        "phases": [
+            {
+                "phase": 0,
+                "stats": {},
+                "spans": {},
+                "events": [],
+                "good": True,
+                "metrics": {
+                    "counters": {
+                        "serve/requests_completed": 6.0,
+                        "serve/requests_completed[tenant=gold]": 4.0,
+                    },
+                    "gauges": {},
+                    "histograms": {
+                        "serve/queue_wait_ms": {
+                            "count": 6, "p50": 3.0, "p95": 9.0,
+                            "min": 1.0, "max": 9.5, "mean": 4.0,
+                        },
+                        "serve/queue_wait_ms[tenant=gold]": {
+                            "count": 4, "p50": 2.0, "p95": 8.0,
+                            "min": 1.0, "max": 8.5, "mean": 3.0,
+                        },
+                        "serve/e2e_ms[tenant=bronze]": {
+                            "count": 2, "p50": 40.0, "p95": 80.0,
+                            "min": 30.0, "max": 81.0, "mean": 50.0,
+                        },
+                    },
+                },
+            }
+        ],
+        "events": [],
+    }
+    out = inspect_dump(payload)
+    assert "serving metrics by tenant" in out
+    gold_row = next(
+        ln for ln in out.splitlines()
+        if ln.strip().startswith("gold") and "queue_wait" in ln
+    )
+    assert "serve/queue_wait_ms" in gold_row and "4" in gold_row
+    assert any(
+        ln.strip().startswith("bronze") and "serve/e2e_ms" in ln
+        for ln in out.splitlines()
+    )
+    # the aggregate table no longer double-renders the labeled rows
+    snapshot_section = out.split("serving metrics by tenant")[0]
+    assert "[tenant=" not in snapshot_section
+
+
+def test_compare_movers_diff_tenant_labeled_keys():
+    from trlx_tpu.telemetry.metrics import (
+        flatten_snapshot,
+        split_metric_label,
+    )
+    from trlx_tpu.telemetry.run_ledger import compare_runs, flatten_numeric
+
+    assert split_metric_label("serve/e2e_ms[tenant=gold]") == (
+        "serve/e2e_ms", "[tenant=gold]",
+    )
+    assert split_metric_label("serve/e2e_ms") == ("serve/e2e_ms", "")
+
+    def manifest(p50):
+        return {
+            "run_id": f"r{p50}",
+            "kind": "serving-smoke",
+            "payload": {},
+            "metrics": {
+                "counters": {},
+                "gauges": {},
+                "histograms": {
+                    "serve/e2e_ms[tenant=gold]": {
+                        "count": 4, "p50": p50,
+                    },
+                },
+            },
+        }
+
+    # the label stays terminal so the family prefix survives the
+    # histogram-stat flattening
+    flat = flatten_snapshot(manifest(10.0)["metrics"])
+    assert flat["serve/e2e_ms/p50[tenant=gold]"] == 10.0
+    a, b = manifest(10.0), manifest(20.0)
+    assert (
+        flatten_numeric(a)["metrics/serve/e2e_ms/p50[tenant=gold]"] == 10.0
+    )
+    out = compare_runs(a, b)
+    mover = next(
+        ln for ln in out.splitlines() if "[tenant=gold]" in ln
+    )
+    assert "serve/e2e_ms/p50[tenant=gold]" in mover
+    assert "+100.0%" in mover

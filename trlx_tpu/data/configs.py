@@ -247,6 +247,14 @@ class TrainConfig:
     # pool); tenants/slo_classes type the QoS scheduler's admission.
     serving: Dict[str, Any] = field(default_factory=dict)
 
+    # Span-tracer tuning (trlx_tpu/telemetry, docs/observability.md):
+    # {"ring_size": N} — capacity of the bounded span ring. Per-request
+    # serving traces (request_trace.py) multiply span volume, so a
+    # high-traffic InferenceServer deployment raises this; the
+    # TRLX_TELEMETRY_RING env var overrides. Default {} keeps the
+    # built-in ring (tracer.DEFAULT_RING_SIZE).
+    telemetry: Dict[str, Any] = field(default_factory=dict)
+
     # Asynchronous actor–learner PPO (docs/async_pipeline.md):
     # {"enabled": true, "staleness_window": 1, "actor_fraction": 1.0} —
     # parsed into trlx_tpu.trainer.async_rl.AsyncRLConfig. With enabled

@@ -130,7 +130,7 @@ def serving_smoke(mesh=None, n_prompts: int = 6) -> int:
     return 0
 
 
-def multi_tenant_smoke(mesh=None) -> int:
+def multi_tenant_smoke(mesh=None, span_log=None) -> int:
     """The serving-tier QoS smoke (docs/serving.md; CI serving-smoke
     job, multi-tenant step). One CPU run must demonstrate:
 
@@ -148,7 +148,15 @@ def multi_tenant_smoke(mesh=None) -> int:
       yields a nonzero ``engine/prefix_hit_rate``;
     - **per-tenant metrics**: ``serve/*[tenant=...]`` histogram keys
       land in the artifact with nonzero counts;
+    - **request tracing**: every completed request emitted a closed
+      ``serve/request`` span chain and the span ring dropped NOTHING
+      (an evicting ring silently truncates traces — the assert is the
+      capacity canary for telemetry.ring_size);
     - **zero health events** on this clean run.
+
+    ``span_log`` exports the whole span stream (phase + request spans
+    and counter tracks, one Perfetto JSONL) — the CI job feeds it to
+    ``python -m trlx_tpu.telemetry --trace-report``.
     """
     import numpy as np
 
@@ -228,6 +236,12 @@ def multi_tenant_smoke(mesh=None) -> int:
     metrics = server.metrics()
     events = server.health_events
 
+    tracer = telemetry.get_tracer()
+    request_spans = (
+        [s for s in tracer.spans() if s.name == "serve/request"]
+        if tracer.enabled
+        else []
+    )
     record = {
         "completion_order_tenants": [
             "gold" if r in set(gold + [stream_rid]) else "bronze"
@@ -244,10 +258,26 @@ def multi_tenant_smoke(mesh=None) -> int:
         "prefix_hit_rate": stats["engine/prefix_hit_rate"],
         "prefix_blocks_saved": stats["engine/prefix_blocks_saved"],
         "released_placeholders": stats["engine/released"],
+        "request_spans": len(request_spans),
+        "spans_dropped": int(tracer.dropped),
         "health_events": [ev.to_dict() for ev in events],
         "serving_metrics": metrics,
+        # the full engine/scheduler counter row (engine/prefix_hit_rate,
+        # engine/released, scheduler/*) — the CI job asserts on these
+        # keys in the artifact, same as the single-tenant smoke
+        **stats,
     }
     print(json.dumps(record))
+    if span_log and tracer.enabled:
+        n_events = telemetry.export_chrome_jsonl(
+            span_log,
+            tracer.spans(),
+            counters=telemetry.get_metrics().gauge_series(),
+        )
+        print(
+            f"mt-smoke: exported {n_events} trace events to {span_log}",
+            file=sys.stderr,
+        )
 
     failures = []
     if len(results) != 9 or any(
@@ -282,6 +312,21 @@ def multi_tenant_smoke(mesh=None) -> int:
             key = f"serve/queue_wait_ms[tenant={tenant}]"
             if not metrics.get(key, {}).get("count"):
                 failures.append(f"missing per-tenant histogram {key}")
+    if tracer.enabled:
+        # trace completeness + capacity canary: one closed request-span
+        # chain per completed request, zero ring evictions (a dropped
+        # span truncates a trace silently — raise telemetry.ring_size)
+        if len(request_spans) < len(results):
+            failures.append(
+                f"request tracing incomplete: {len(request_spans)} "
+                f"serve/request spans for {len(results)} completed "
+                "requests"
+            )
+        if telemetry.warn_on_span_drops(tracer):
+            failures.append(
+                f"span ring dropped {tracer.dropped} spans — raise "
+                "telemetry.ring_size / TRLX_TELEMETRY_RING"
+            )
     if events:
         failures.append(f"{len(events)} health events on a clean run")
     if failures:
@@ -314,13 +359,20 @@ def main(argv=None) -> int:
         help="run the multi-tenant QoS smoke: priority ordering, "
         "quota throttling without starvation, streamed TTFT below "
         "harvest TTFT, nonzero prefix-sharing hit rate, per-tenant "
-        "serve/* histograms, zero health events",
+        "serve/* histograms, complete request traces with zero span "
+        "drops, zero health events",
+    )
+    parser.add_argument(
+        "--span-log", metavar="PATH", default=None,
+        help="with --mt-smoke: export the run's span stream (phase + "
+        "per-request spans + counter tracks) as Perfetto JSONL — the "
+        "input of `python -m trlx_tpu.telemetry --trace-report`",
     )
     args = parser.parse_args(argv)
     if args.smoke:
         return serving_smoke()
     if args.mt_smoke:
-        return multi_tenant_smoke()
+        return multi_tenant_smoke(span_log=args.span_log)
     parser.print_help()
     return 2
 

@@ -307,6 +307,20 @@ class ContinuousBatchingEngine:
         # latency histograms. Host dispatch timing — on an accelerator
         # the prefill mark is the dispatch wall, not device occupancy.
         self._req_times: Dict[int, Dict[str, float]] = {}
+        #: request tracing (telemetry/request_trace.py): with this on,
+        #: the host loop additionally logs one (dispatch time, admission
+        #: epoch) pair per decode step and stamps done-poll marks, so
+        #: the serving tier can emit per-request decode-cadence spans —
+        #: the decode-step gap structure is the device-occupancy bound
+        #: the dispatch-wall spans cannot give. Off (the default, and
+        #: the trainer collect path) adds zero host work per step.
+        self.trace_requests = False
+        # the cadence log is PRUNED as rows harvest (entries below every
+        # in-flight row's admit window drop; _step_base keeps the marks'
+        # absolute indices valid) — a long-lived server's memory stays
+        # bounded by its in-flight window, not its lifetime
+        self._step_log: List[Tuple[float, int]] = []
+        self._step_base = 0
 
     # ------------------------- jitted programs ------------------------- #
 
@@ -732,6 +746,8 @@ class ContinuousBatchingEngine:
         self._steps_since_poll = 0
         self.stats = EngineStats(num_slots=self.num_slots)
         self._req_times = {}
+        self._step_log = []
+        self._step_base = 0
 
     def push_weights(self, params, version: Optional[int] = None) -> None:
         """Stage a refreshed behavior policy for in-flight application
@@ -874,6 +890,21 @@ class ContinuousBatchingEngine:
         ``None`` for unknown/unfinished rows. Host dispatch timing on
         the shared telemetry clock; the serving layer divides
         ``decode_ms`` by the row's token count for per-token decode."""
+        record = self.pop_request_record(row)
+        return None if record is None else record["timing"]
+
+    def pop_request_record(self, row: int) -> Optional[Dict[str, Any]]:
+        """The full per-request trace record for a HARVESTED row — the
+        ``timing`` decomposition of :meth:`pop_request_timing` plus the
+        raw ``marks`` (submit/admit/first-token/done/completed seconds
+        on the shared telemetry clock) and, under
+        :attr:`trace_requests`, the row's decode-cadence slice:
+        ``step_times`` (dispatch wall per decode step while the row was
+        live) and ``step_epochs`` (the admission-prefill count at each
+        step — an epoch change mid-row means the host loop interrupted
+        this row's decode run to admit another group, which is exactly
+        the bubble the trace analyzer attributes). Popped — each row
+        reports once."""
         marks = self._req_times.get(row)
         if not marks or "completed" not in marks:
             return None
@@ -883,13 +914,28 @@ class ContinuousBatchingEngine:
         first = marks.get("first_token", admitted)
         completed = marks["completed"]
         ms = 1000.0
-        return {
-            "queue_wait_ms": max(0.0, (admitted - submitted) * ms),
-            "prefill_ms": max(0.0, (first - admitted) * ms),
-            "ttft_ms": max(0.0, (first - submitted) * ms),
-            "decode_ms": max(0.0, (completed - first) * ms),
-            "e2e_ms": max(0.0, (completed - submitted) * ms),
+        record: Dict[str, Any] = {
+            "timing": {
+                "queue_wait_ms": max(0.0, (admitted - submitted) * ms),
+                "prefill_ms": max(0.0, (first - admitted) * ms),
+                "ttft_ms": max(0.0, (first - submitted) * ms),
+                "decode_ms": max(0.0, (completed - first) * ms),
+                "e2e_ms": max(0.0, (completed - submitted) * ms),
+            },
+            "marks": dict(marks),
         }
+        step_log = getattr(self, "_step_log", None)
+        if step_log and "admit_step" in marks:
+            base = getattr(self, "_step_base", 0)
+            lo = max(0, int(marks["admit_step"]) - base)
+            hi = min(
+                int(marks.get("done_step", base + len(step_log))) - base,
+                len(step_log),
+            )
+            window = step_log[lo:hi]
+            record["step_times"] = [t for t, _ in window]
+            record["step_epochs"] = [e for _, e in window]
+        return record
 
     def _admit(self) -> None:
         """Refill free slots from the queue, one padded prefill call per
@@ -985,6 +1031,13 @@ class ContinuousBatchingEngine:
                 if marks is not None:
                     marks["admitted"] = t_admit
                     marks["first_token"] = t_first
+                    if self.trace_requests:
+                        # decode-cadence window start: this row's live
+                        # steps begin at the current step-log position
+                        # (absolute index — survives log pruning)
+                        marks["admit_step"] = (
+                            self._step_base + len(self._step_log)
+                        )
             self.stats.prefills += 1
             self.stats.admitted += take
             if sharing:
@@ -1027,7 +1080,32 @@ class ContinuousBatchingEngine:
             # host-side behavior-version tag per row (admission version):
             # the stream store's version column / staleness accounting
             outs["versions"] = versions
+            if self.trace_requests:
+                self._prune_step_log()
             yield outs
+
+    def _prune_step_log(self) -> None:
+        """Drop cadence-log entries no un-popped request can still
+        reference (everything below the minimum in-flight ``admit_step``
+        — un-admitted rows stamp at or past the current end, so they
+        never constrain). ``_step_base`` keeps the retained marks'
+        absolute indices valid. Bounds a long-lived server's cadence
+        memory by its in-flight window instead of its lifetime."""
+        if not self._step_log:
+            return
+        end = self._step_base + len(self._step_log)
+        floor = min(
+            (
+                int(m["admit_step"])
+                for m in self._req_times.values()
+                if "admit_step" in m
+            ),
+            default=end,
+        )
+        drop = min(floor, end) - self._step_base
+        if drop > 0:
+            del self._step_log[:drop]
+            self._step_base += drop
 
     def drive(self, target: int) -> Iterator[Dict[str, Any]]:
         """Run the admission/decode/harvest loop until ``target``
@@ -1087,6 +1165,15 @@ class ContinuousBatchingEngine:
             pass
         self.stats.decode_steps += 1
         self.stats.occupancy_sum += len(self._busy_rows)
+        if self.trace_requests:
+            # one (dispatch wall, admission epoch) pair per decode step:
+            # the per-request cadence slice the trace analyzer turns
+            # into host-loop/admission bubble estimates. Epoch = the
+            # prefill count, so an epoch change inside a row's window
+            # marks the admission that interrupted its decode run.
+            self._step_log.append(
+                (telemetry.monotonic(), self.stats.prefills)
+            )
         if token is not None and self.token_sink is not None:
             # streaming tap: route this step's live emissions to the
             # per-request queues NOW — time-to-first-token decouples
@@ -1119,9 +1206,21 @@ class ContinuousBatchingEngine:
         telemetry.get_metrics().gauge("engine/slot_util").set(
             self.stats.slot_util
         )
+        t_done = telemetry.monotonic() if self.trace_requests else 0.0
         for slot, row in list(self._busy_rows.items()):
             if done_host[slot] and slot not in self._done_slots:
                 self._done_slots.append(slot)
+                if self.trace_requests:
+                    # host-visible decode end: the harvest-wait stage
+                    # (done → refill) starts here. With amortized
+                    # polling (k>1) this lags the device by up to k-1
+                    # steps — it is the host-observable bound.
+                    marks = self._req_times.get(row)
+                    if marks is not None:
+                        marks["done"] = t_done
+                        marks["done_step"] = (
+                            self._step_base + len(self._step_log)
+                        )
 
     # ------------------------- serving interface ----------------------- #
 

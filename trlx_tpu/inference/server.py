@@ -267,7 +267,15 @@ class InferenceServer:
 
         from trlx_tpu import telemetry
 
+        # span-ring capacity (train.telemetry.ring_size): per-request
+        # traces multiply span volume; size the ring before traffic
+        telemetry.configure_from_dict(getattr(train, "telemetry", None))
         self._registry = telemetry.get_metrics()
+        # request tracing (telemetry/request_trace.py): with the tracer
+        # enabled the engine logs decode-step cadence and done marks so
+        # every completed request emits a parented span chain; disabled
+        # keeps the host loop's per-step cost at zero (NULL_SPAN contract)
+        self.engine.trace_requests = telemetry.get_tracer().enabled
         self.scheduler = build_scheduler(
             self.serving_config, registry=self._registry
         )
@@ -292,6 +300,12 @@ class InferenceServer:
             HealthConfig.from_dict({"enabled": True})
         )
         self._requests: Dict[int, Any] = {}  # request_id -> Request
+        # trace-emission retention: Request refs (tenant/priority/trace
+        # marks) kept until the row HARVESTS — pop_result may drop
+        # _requests mid-flight, but an abandoned request's span chain
+        # must still close when its row completes
+        self._trace_reqs: Dict[int, Any] = {}
+        self._plan_windows: Dict[int, Any] = {}  # rid -> (t0, t1)
         self._row_to_req: Dict[int, int] = {}  # engine row -> request_id
         self._req_row: Dict[int, int] = {}  # request_id -> engine row
         self._acquired: Dict[int, List[int]] = {}  # rid -> pool blocks
@@ -353,11 +367,13 @@ class InferenceServer:
         from trlx_tpu import telemetry
         from trlx_tpu.serving.scheduler import Request
         from trlx_tpu.serving.streaming import TokenStream
+        from trlx_tpu.telemetry.request_trace import mint_trace_id
 
         tenant_cfg = self.scheduler.tenant_config(tenant)
         prio = tenant_cfg.priority if priority is None else int(priority)
         slo = tenant_cfg.slo_class if slo_class is None else slo_class
         now = telemetry.monotonic()
+        tracing = telemetry.get_tracer().enabled
         # build + validate the WHOLE batch before enqueueing anything:
         # a mid-batch refusal (over-long prompt, unadmittable cost)
         # must not orphan earlier requests whose ids the caller never
@@ -365,8 +381,9 @@ class InferenceServer:
         reqs = []
         for i, p in enumerate(prompts):
             ids, mask = self._pad_prompt(self._encode(p), i)
+            request_id = next(self._next_request)
             req = Request(
-                request_id=next(self._next_request),
+                request_id=request_id,
                 tenant=tenant,
                 prompt_ids=ids,
                 prompt_mask=mask,
@@ -381,6 +398,7 @@ class InferenceServer:
                 stream=bool(stream),
                 cost=float(int(mask.sum()) + self.engine.R),
                 submitted_at=now,
+                trace_id=mint_trace_id(request_id),
             )
             self.scheduler.validate(req)
             reqs.append(req)
@@ -389,6 +407,8 @@ class InferenceServer:
             rid = req.request_id
             self.scheduler.submit(req)
             self._requests[rid] = req
+            if tracing:
+                self._trace_reqs[rid] = req
             self._open[rid] = True
             if stream:
                 self._streams[rid] = TokenStream(
@@ -425,8 +445,10 @@ class InferenceServer:
 
     def _engine_submit(self, batch) -> None:
         """Move scheduler picks into the engine's admission queue."""
+        from trlx_tpu import telemetry
         from trlx_tpu.utils.retry import retry_call
 
+        tracing = telemetry.get_tracer().enabled
         n = len(batch)
         Q = self.query_length
         ids = np.zeros((n, Q), np.int32)
@@ -437,10 +459,16 @@ class InferenceServer:
             ids[i] = req.prompt_ids
             mask[i] = req.prompt_mask
             if self.prefix_pool is not None:
+                t_plan = telemetry.monotonic() if tracing else 0.0
                 plan = self.prefix_pool.plan_admission(
                     req.prompt_ids, req.prompt_mask,
                     eligible_blocks=Q // self.engine.block_size,
                 )
+                if tracing:
+                    # prefix-plan overlay span of the request's trace
+                    self._plan_windows[req.request_id] = (
+                        t_plan, telemetry.monotonic()
+                    )
                 plans.append(plan)
         if plans:
             shared_maps = np.stack([p.shared_map for p in plans])
@@ -469,6 +497,8 @@ class InferenceServer:
                 for plan in plans:
                     if plan.acquired:
                         self.prefix_pool.abandon(plan.acquired)
+            for req in batch:
+                self._plan_windows.pop(req.request_id, None)
             raise
         for i, (row, req) in enumerate(zip(rows, batch)):
             self._row_to_req[row] = req.request_id
@@ -553,7 +583,8 @@ class InferenceServer:
         mask = np.asarray(jax.device_get(group["response_mask"]))
         self._observe_group(group)
         for j, row in enumerate(group["rows"]):
-            timing = engine.pop_request_timing(row)
+            record = engine.pop_request_record(row)
+            timing = record["timing"] if record else None
             rid = self._row_to_req.pop(row, None)
             self._published_by_row.pop(row, None)
             # refcounts drop for EVERY harvested row with a plan — also
@@ -570,10 +601,17 @@ class InferenceServer:
             stream = self._router.pop(row)
             if stream is not None:
                 stream.close()
+            length = int(mask[j].sum()) if rid is not None else 0
             if rid is None or not self._open.get(rid):
-                continue  # placeholder / already-closed row
+                # placeholder / already-closed row. An early-popped
+                # request's row still decoded to harvest — its span
+                # chain closes here too (status=abandoned), so trace
+                # completeness covers every completed row
+                self._finish_trace(
+                    rid, record, stream, length, status="abandoned"
+                )
+                continue
             req = self._requests[rid]
-            length = int(mask[j].sum())
             if timing is not None:
                 observe_request_metrics(
                     self._registry, timing, length, tenant=req.tenant
@@ -590,6 +628,55 @@ class InferenceServer:
             self._results[rid] = out
             self._open[rid] = False
             self.completion_order.append(rid)
+            self._finish_trace(rid, record, stream, length)
+
+    def _finish_trace(
+        self, rid, record, stream, tokens: int, status: str = "ok"
+    ) -> None:
+        """Close one harvested request's distributed trace: turn the
+        retained scheduler marks + the engine's popped record + the
+        stream's delivery marks into the parented span chain
+        (telemetry/request_trace.py). No-op for placeholder rows, for
+        requests submitted while tracing was off, and when the tracer
+        is disabled now."""
+        req = self._trace_reqs.pop(rid, None) if rid is not None else None
+        if req is None or record is None:
+            if rid is not None:
+                self._plan_windows.pop(rid, None)
+            return
+        from trlx_tpu import telemetry
+        from trlx_tpu.telemetry.request_trace import emit_request_trace
+
+        tracer = telemetry.get_tracer()
+        if not tracer.enabled:
+            self._plan_windows.pop(rid, None)
+            return
+        stream_window = None
+        if stream is not None and stream.first_push_at is not None:
+            stream_window = (
+                stream.first_push_at,
+                stream.closed_at or stream.first_push_at,
+            )
+        emit_request_trace(
+            tracer,
+            trace_id=req.trace_id,
+            request_id=req.request_id,
+            tenant=req.tenant,
+            priority=req.priority,
+            slo_class=req.slo_class,
+            streamed=req.stream,
+            tokens=tokens,
+            marks=record["marks"],
+            timing=record["timing"],
+            delivered=telemetry.monotonic(),
+            status=status,
+            quota_blocked_at=req.quota_blocked_at,
+            picked_at=req.picked_at or None,
+            step_times=record.get("step_times"),
+            step_epochs=record.get("step_epochs"),
+            plan_window=self._plan_windows.pop(rid, None),
+            stream_window=stream_window,
+        )
 
     def flush(self) -> int:
         """Drive the serving loop until every submitted request has

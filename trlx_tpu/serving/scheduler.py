@@ -145,6 +145,14 @@ class Request:
     cost: float = 0.0
     submitted_at: float = 0.0
     seq: int = 0  # global submission order (final tie-break)
+    # distributed-tracing marks (telemetry/request_trace.py): the
+    # trace_id minted at InferenceServer.submit, the first time the
+    # request was skipped because its tenant's quota was exhausted
+    # (the quota-hold stage starts here), and the pick time (scheduler
+    # → engine handoff). Host floats on the shared telemetry clock.
+    trace_id: str = ""
+    quota_blocked_at: Optional[float] = None
+    picked_at: float = 0.0
 
 
 class QoSScheduler:
@@ -307,8 +315,14 @@ class QoSScheduler:
                 # later request first would reorder the tenant's FIFO)
                 blocked.add(req.tenant)
                 self.throttled_rounds += 1
+                # quota-hold trace mark: every queued request of the
+                # throttled tenant starts (or continues) its hold here
+                for held in self._queues[req.tenant]:
+                    if held.quota_blocked_at is None:
+                        held.quota_blocked_at = now
                 continue
             self._queues[req.tenant].remove(req)
+            req.picked_at = now
             picked.append(req)
             self.admitted += 1
         return picked

@@ -21,6 +21,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, Iterator, List, Optional
 
+from trlx_tpu.telemetry.tracer import monotonic
+
 
 class TokenStream:
     """Bounded per-request token queue with iterator access.
@@ -43,14 +45,23 @@ class TokenStream:
         self.closed = False
         self.overflows = 0  # tokens dropped oldest-first on a full queue
         self.emitted = 0
+        # stream-delivery trace marks (telemetry/request_trace.py): when
+        # the first token reached this queue and when the stream closed
+        # — the `serve/stream` span of the request's trace
+        self.first_push_at: Optional[float] = None
+        self.closed_at: Optional[float] = None
 
     def push(self, token: int) -> None:
         if len(self._buf) == self._buf.maxlen:
             self.overflows += 1
         self._buf.append(int(token))
         self.emitted += 1
+        if self.first_push_at is None:
+            self.first_push_at = monotonic()
 
     def close(self) -> None:
+        if not self.closed:
+            self.closed_at = monotonic()
         self.closed = True
 
     def __iter__(self) -> Iterator[int]:

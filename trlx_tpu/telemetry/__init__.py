@@ -54,12 +54,14 @@ from contextlib import contextmanager
 from typing import Optional
 
 from trlx_tpu.telemetry.tracer import (  # noqa: F401
+    DEFAULT_RING_SIZE,
     NULL_SPAN,
     Span,
     Tracer,
     chrome_counter_events,
     chrome_trace_events,
     chrome_trace_from_jsonl,
+    env_ring_size,
     export_chrome_jsonl,
     monotonic,
     quantile,
@@ -74,9 +76,11 @@ from trlx_tpu.telemetry.metrics import (  # noqa: F401  (after tracer: shares it
     flatten_snapshot,
     get_metrics,
     scoped_metrics,
+    split_metric_label,
 )
 
 __all__ = [
+    "DEFAULT_RING_SIZE",
     "NULL_SPAN",
     "Span",
     "Tracer",
@@ -84,7 +88,9 @@ __all__ = [
     "chrome_trace_events",
     "chrome_trace_from_jsonl",
     "configure",
+    "configure_from_dict",
     "configure_metrics",
+    "env_ring_size",
     "export_chrome_jsonl",
     "get_metrics",
     "get_tracer",
@@ -117,10 +123,13 @@ def _default_enabled() -> bool:
 
 
 def get_tracer() -> Tracer:
-    """The process-global tracer (created on first use)."""
+    """The process-global tracer (created on first use; ring capacity
+    from ``TRLX_TELEMETRY_RING`` when set)."""
     global _tracer
     if _tracer is None:
-        _tracer = Tracer(enabled=_default_enabled())
+        _tracer = Tracer(
+            enabled=_default_enabled(), max_records=env_ring_size()
+        )
     return _tracer
 
 
@@ -188,3 +197,41 @@ def configure(
     if max_records is not None:
         tracer.set_max_records(max_records)
     return tracer
+
+
+def configure_from_dict(d) -> Tracer:
+    """Apply the ``train.telemetry`` config section (and return the
+    global tracer). One knob today — ``ring_size``, the span-ring
+    capacity (per-request serving spans multiply span volume; an
+    evicting ring truncates every trace the ``--trace-report`` analyzer
+    reads). Unknown keys refuse loudly, like every other config section.
+    Precedence: an explicit ``TRLX_TELEMETRY_RING`` env var wins over
+    the config — the operator at the terminal outranks the YAML."""
+    d = dict(d or {})
+    known = {"ring_size"}
+    unknown = set(d) - known
+    if unknown:
+        raise ValueError(
+            f"Unknown train.telemetry keys: {sorted(unknown)} "
+            f"(known: {sorted(known)})"
+        )
+    ring = d.get("ring_size")
+    if ring is not None:
+        # validate BEFORE precedence: a bad YAML value must refuse on
+        # every machine, not only the ones without an env override
+        ring = int(ring)
+        if ring < 1:
+            raise ValueError(
+                f"train.telemetry.ring_size={ring} must be >= 1"
+            )
+        # a VALID env override wins; a malformed one (which
+        # env_ring_size already ignores) must not ALSO block the
+        # config — validity decides precedence, not mere presence
+        raw = os.environ.get("TRLX_TELEMETRY_RING")
+        try:
+            env_valid = raw is not None and int(raw) > 0
+        except ValueError:
+            env_valid = False
+        if not env_valid:
+            return configure(max_records=ring)
+    return get_tracer()

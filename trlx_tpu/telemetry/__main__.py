@@ -1,11 +1,12 @@
-"""CLI: flight-dump triage, run-ledger comparison, live run watching.
+"""CLI: flight-dump triage, run comparison, run watching, trace reports.
 
-Three subtools behind one entry point (docs/observability.md):
+Four subtools behind one entry point (docs/observability.md):
 
 - ``python -m trlx_tpu.telemetry --inspect <dump.json>`` — render a
   flight-recorder forensics dump as the human triage view: run header +
   error, the tripped-detector table, the last-good-phase stats diff,
-  span p50 deltas, and the final phase's metrics snapshot. ``--json``
+  span p50 deltas, and the final phase's metrics snapshot (including
+  the per-tenant ``serve/*[tenant=…]`` histogram rows). ``--json``
   re-emits a machine-readable summary instead.
 - ``python -m trlx_tpu.telemetry --compare <run_a> <run_b>`` — resolve
   two run-ledger manifests (run_id, ledger index like ``-1``, or a
@@ -16,6 +17,11 @@ Three subtools behind one entry point (docs/observability.md):
   ``phases.jsonl`` a ``train.run_dir`` run mirrors its phase records
   into, one line per phase (``--no-follow`` renders what exists and
   exits — the CI/test mode).
+- ``python -m trlx_tpu.telemetry --trace-report <spans.jsonl>`` —
+  per-request critical-path decomposition, per-tenant/SLO-class tail
+  breakdown, and the decode-cadence bubble estimate over an exported
+  span log carrying request traces (telemetry/trace_report.py;
+  docs/observability.md "Request tracing").
 
 Exit status: 0 on success, 2 on unreadable/unresolvable inputs. (The
 content never affects the exit code — these are viewers, not gates.)
@@ -68,11 +74,38 @@ def main(argv=None) -> int:
         help="with --watch: render the rows on disk and exit",
     )
     parser.add_argument(
+        "--trace-report",
+        metavar="SPANS",
+        help=(
+            "span JSONL with per-request traces: render the "
+            "critical-path / tenant-tail / decode-bubble report"
+        ),
+    )
+    parser.add_argument(
         "--json",
         action="store_true",
         help="emit a machine-readable summary instead of the triage view",
     )
     args = parser.parse_args(argv)
+
+    if args.trace_report:
+        from trlx_tpu.telemetry.trace_report import (
+            render_report,
+            report_json,
+        )
+
+        try:
+            if args.json:
+                print(json.dumps(report_json(args.trace_report)))
+            else:
+                print(render_report(args.trace_report))
+        except OSError as e:
+            print(
+                f"error: cannot read {args.trace_report}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        return 0
 
     if args.compare:
         from trlx_tpu.telemetry.run_ledger import compare_runs, resolve_run

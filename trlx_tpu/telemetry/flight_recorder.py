@@ -362,22 +362,47 @@ def inspect_dump(payload: Dict[str, Any]) -> str:
 
     # metrics-registry snapshot of the final recorded phase: the
     # unified namespace (engine occupancy, async bubbles, mem gauges,
-    # serving histograms) the run held when it went down
+    # serving histograms) the run held when it went down. Tenant-labeled
+    # rows render in their own per-tenant table below — crowding them
+    # into this capped list would truncate exactly the multi-tenant
+    # triage the labels exist for.
+    from trlx_tpu.telemetry.metrics import split_metric_label
+
+    def tenant_of(name: str):
+        """(base, tenant) via the one shared label parser — None tenant
+        for unlabeled (or differently-labeled) names."""
+        base, label = split_metric_label(name)
+        if label.startswith("[tenant="):
+            return base, label[len("[tenant="):-1]
+        return name, None
+
     final_metrics = (phases[-1].get("metrics") or {}) if phases else {}
     flat_metrics: List[tuple] = []
-    for name, value in (final_metrics.get("counters") or {}).items():
-        flat_metrics.append((name, _fmt(float(value))))
-    for name, value in (final_metrics.get("gauges") or {}).items():
-        flat_metrics.append((name, _fmt(float(value))))
-    for name, summary in (final_metrics.get("histograms") or {}).items():
-        if summary.get("count"):
-            flat_metrics.append(
-                (
-                    name,
-                    f"p50={_fmt(float(summary.get('p50', 0.0)))} "
-                    f"n={int(summary['count'])}",
+    tenant_rows: List[tuple] = []  # (tenant, base metric, summary)
+    scalar_tenant_rows: List[tuple] = []  # (tenant, name, rendered)
+    for section in ("counters", "gauges"):
+        for name, value in (final_metrics.get(section) or {}).items():
+            base, tenant = tenant_of(name)
+            if tenant is not None:
+                scalar_tenant_rows.append(
+                    (tenant, base, _fmt(float(value)))
                 )
+            else:
+                flat_metrics.append((name, _fmt(float(value))))
+    for name, summary in (final_metrics.get("histograms") or {}).items():
+        if not summary.get("count"):
+            continue
+        base, tenant = tenant_of(name)
+        if tenant is not None:
+            tenant_rows.append((tenant, base, summary))
+            continue
+        flat_metrics.append(
+            (
+                name,
+                f"p50={_fmt(float(summary.get('p50', 0.0)))} "
+                f"n={int(summary['count'])}",
             )
+        )
     if flat_metrics:
         lines.append("")
         lines.append("metrics snapshot (final phase):")
@@ -385,6 +410,22 @@ def inspect_dump(payload: Dict[str, Any]) -> str:
             lines.append(f"  {name:32} {rendered:>16}")
         if len(flat_metrics) > 16:
             lines.append(f"  ... {len(flat_metrics) - 16} more")
+    if tenant_rows or scalar_tenant_rows:
+        lines.append("")
+        lines.append("serving metrics by tenant (final phase):")
+        lines.append(
+            f"  {'tenant':12} {'metric':28} {'n':>6} {'p50':>10} "
+            f"{'p95':>10} {'max':>10}"
+        )
+        for tenant, base, summary in sorted(tenant_rows):
+            lines.append(
+                f"  {tenant:12} {base:28} {int(summary['count']):>6} "
+                f"{_fmt(float(summary.get('p50', 0.0))):>10} "
+                f"{_fmt(float(summary.get('p95', 0.0))):>10} "
+                f"{_fmt(float(summary.get('max', 0.0))):>10}"
+            )
+        for tenant, base, rendered in sorted(scalar_tenant_rows):
+            lines.append(f"  {tenant:12} {base:28} {rendered:>6}")
 
     # last-good vs final phase
     final = phases[-1] if phases else None

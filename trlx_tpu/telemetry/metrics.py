@@ -257,12 +257,32 @@ class MetricsRegistry:
         return out
 
 
+def split_metric_label(name: str) -> "Tuple[str, str]":
+    """``(base, label)`` of a possibly-labeled metric name:
+    ``serve/queue_wait_ms[tenant=gold]`` → ``("serve/queue_wait_ms",
+    "[tenant=gold]")``; unlabeled names return ``(name, "")``. One
+    parser for every consumer of the flat ``[k=v]``-suffix convention
+    (tenant histograms today)."""
+    if name.endswith("]"):
+        cut = name.find("[")
+        if cut > 0:
+            return name[:cut], name[cut:]
+    return name, ""
+
+
 def flatten_snapshot(
     snap: Optional[Dict[str, Dict[str, Any]]]
 ) -> Dict[str, float]:
     """A :meth:`MetricsRegistry.snapshot` as one flat numeric dict —
     counters/gauges keep their names, histogram summaries flatten to
-    ``name/p50``-style keys. The run-ledger movers diff compares these."""
+    ``name/p50``-style keys. The run-ledger movers diff compares these.
+
+    Labeled histogram names keep their label TERMINAL:
+    ``serve/queue_wait_ms[tenant=gold]`` flattens to
+    ``serve/queue_wait_ms/p50[tenant=gold]`` — the metric family stays
+    one contiguous prefix, so the ``--compare`` movers diff sorts and
+    matches tenant-labeled series next to their aggregates instead of
+    splitting the family at the bracket."""
     out: Dict[str, float] = {}
     if not snap:
         return out
@@ -271,8 +291,9 @@ def flatten_snapshot(
     for name, value in (snap.get("gauges") or {}).items():
         out[name] = float(value)
     for name, summary in (snap.get("histograms") or {}).items():
+        base, label = split_metric_label(name)
         for stat, value in (summary or {}).items():
-            out[f"{name}/{stat}"] = float(value)
+            out[f"{base}/{stat}{label}"] = float(value)
     return out
 
 

@@ -41,6 +41,24 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 #: repo — Clock, Logger, spans, the perf lockfile — derives from this.
 monotonic: Callable[[], float] = time.monotonic
 
+#: default span-ring capacity; override per process with the
+#: ``TRLX_TELEMETRY_RING`` env var or ``train.telemetry.ring_size``
+#: (per-request serving spans multiply span volume — docs/observability.md)
+DEFAULT_RING_SIZE = 65536
+
+
+def env_ring_size() -> int:
+    """The span-ring capacity the environment asks for
+    (``TRLX_TELEMETRY_RING``), falling back to :data:`DEFAULT_RING_SIZE`.
+    A malformed value falls back too — a typo must not kill the run that
+    was trying to observe itself."""
+    raw = os.environ.get("TRLX_TELEMETRY_RING", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        return DEFAULT_RING_SIZE
+    return n if n > 0 else DEFAULT_RING_SIZE
+
 
 class _NullSpan:
     """Shared no-op span returned while the tracer is disabled."""
@@ -143,7 +161,9 @@ class Tracer:
     deque (``maxlen`` drops the oldest — ``dropped`` counts them so a
     truncated trace is visible, never silent)."""
 
-    def __init__(self, enabled: bool = True, max_records: int = 65536):
+    def __init__(
+        self, enabled: bool = True, max_records: int = DEFAULT_RING_SIZE
+    ):
         self.enabled = enabled
         self.dropped = 0
         self._records: "deque[Span]" = deque(maxlen=max_records)
@@ -160,6 +180,29 @@ class Tracer:
         if not self.enabled:
             return Span(name, attrs or None, None) if force else NULL_SPAN
         return Span(name, attrs or None, self)
+
+    def record(self, span: Span, parent: Optional[int] = None) -> Optional[int]:
+        """Record an externally-stamped span — explicit ``start``/``end``
+        already set by the caller, never touching the per-thread stack.
+
+        The per-request serving traces (telemetry/request_trace.py) are
+        built retrospectively at harvest, long after each stage actually
+        ran, so they cannot be context managers: the caller stamps start/
+        end/thread fields and links parents by recorded index (``parent``
+        overrides any pre-set ``span.parent``). Returns the assigned
+        index, or ``None`` when the tracer is disabled (nothing recorded
+        — the disabled-mode cost contract)."""
+        if not self.enabled:
+            return None
+        if parent is not None:
+            span.parent = parent
+        with self._lock:
+            span.index = self._next_index
+            self._next_index += 1
+            if len(self._records) == self._records.maxlen:
+                self.dropped += 1
+            self._records.append(span)
+        return span.index
 
     def clear(self) -> None:
         with self._lock:

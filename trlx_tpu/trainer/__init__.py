@@ -68,6 +68,13 @@ class BaseRLTrainer(ABC):
         self.logit_mask = logit_mask
         self.orch = None  # back-reference installed by the orchestrator
         self.eval_pipeline = None
+        from trlx_tpu import telemetry
+
+        # span-ring capacity (train.telemetry.ring_size,
+        # docs/observability.md): sized before any phase emits spans
+        telemetry.configure_from_dict(
+            getattr(config.train, "telemetry", None)
+        )
         self._setup_health()
 
     def _setup_health(self) -> None:
