@@ -1,0 +1,563 @@
+"""Chunked admission prefill (rollout.prefill_chunk): parity + FLOPs.
+
+The acceptance pins (ISSUE 15 / docs/inference.md "Chunked prefill"):
+
+- chunked <-> monolithic prefill BITWISE parity on tokens/masks (and
+  logprobs/values at the engine's established resolution — exact on the
+  float32 CPU tier here; the bf16 caveat applies to real-mesh runs and
+  is pinned at bf16 tolerance on the fsdp×tp nightly variant), with
+  prefix sharing OFF and ON;
+- the all-skipped-segment edge: an admit group whose rows are ALL
+  shorter than one chunk runs ONLY the finish chunk (the prefill mirror
+  of the segmented-decode all-finished-tail tests);
+- the serving pump's chunk budget interleaves decode with a burst's
+  admission without changing any row's bits;
+- engine-7's exact FLOP count for the chunked pair (scan + finish) is
+  STRICTLY below the monolithic prefill at the same shape.
+
+Engines here are built directly over a tiny float32 model (no trainer
+build — the parity surface is the engine's jitted programs, and the
+trainer integration is covered by test_inference_engine.py through the
+shared construction path).
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.inference import RolloutEngineConfig
+from trlx_tpu.inference.engine import ContinuousBatchingEngine
+from trlx_tpu.inference.kv_cache import choose_prefill_chunk
+from trlx_tpu.ops.sampling import GenerationConfig
+
+
+# ------------------------------- units --------------------------------- #
+
+
+def test_choose_prefill_chunk():
+    # block-aligned divisor of Q preferred
+    assert choose_prefill_chunk(64, 16, 16) == 16
+    assert choose_prefill_chunk(64, 20, 16) == 16  # rounded down to divisor
+    assert choose_prefill_chunk(8, 4, 2) == 4
+    # no block-aligned divisor (bs does not divide Q): largest plain one
+    assert choose_prefill_chunk(8, 4, 14) == 4
+    # clamped to Q
+    assert choose_prefill_chunk(8, 64, 2) == 8
+    # disabled
+    assert choose_prefill_chunk(64, 0, 16) == 0
+    assert choose_prefill_chunk(64, -1, 16) == 0
+
+
+def test_rollout_config_chunk_validation():
+    cfg = RolloutEngineConfig.from_dict(
+        {"engine": "continuous", "prefill_chunk": 16,
+         "prefill_chunks_per_pump": 2}
+    )
+    assert cfg.prefill_chunk == 16 and cfg.prefill_chunks_per_pump == 2
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        RolloutEngineConfig.from_dict({"prefill_chunk": -1})
+    with pytest.raises(ValueError, match="prefill_chunks_per_pump"):
+        RolloutEngineConfig.from_dict({"prefill_chunks_per_pump": -1})
+    with pytest.raises(ValueError, match="needs chunked"):
+        RolloutEngineConfig.from_dict({"prefill_chunks_per_pump": 1})
+    with pytest.raises(ValueError, match="needs chunked"):
+        ContinuousBatchingEngine(
+            apply_fn=lambda *a, **k: None,
+            init_cache_fn=lambda *a, **k: (),
+            gen_config=GenerationConfig(max_new_tokens=4),
+            query_length=8,
+            vocab_size=16,
+            num_slots=2,
+            prefill_chunks_per_pump=1,
+        )
+
+
+# --------------------------- shared fixtures ---------------------------- #
+
+Q, R, VOCAB, EOS = 16, 8, 64, 63
+
+
+@functools.lru_cache(maxsize=None)
+def _model_and_params():
+    from trlx_tpu.models.gpt2 import GPT2Config
+    from trlx_tpu.models.heads import CausalLMWithValueHead
+
+    cfg = GPT2Config(
+        vocab_size=VOCAB, n_positions=64, n_embd=32, n_layer=2,
+        n_head=2, dtype="float32",
+    )
+    model = CausalLMWithValueHead(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, model, params
+
+
+@functools.lru_cache(maxsize=None)
+def _engine(prefill_chunk=0, pool_blocks=0, chunks_per_pump=0):
+    from trlx_tpu.models.gpt2 import init_cache
+
+    cfg, model, _ = _model_and_params()
+
+    def apply_fn(p, input_ids, attention_mask=None, position_ids=None,
+                 cache=None, cache_index=None, last_only=False,
+                 skip_heads=False):
+        return model.apply(
+            {"params": p}, input_ids, attention_mask=attention_mask,
+            position_ids=position_ids, cache=cache,
+            cache_index=cache_index, last_only=last_only,
+            skip_heads=skip_heads,
+        )
+
+    gen = GenerationConfig(
+        max_new_tokens=R, min_new_tokens=1, eos_token_id=EOS,
+        pad_token_id=EOS, do_sample=True,
+    )
+    return ContinuousBatchingEngine(
+        apply_fn=apply_fn,
+        init_cache_fn=functools.partial(init_cache, cfg),
+        gen_config=gen,
+        query_length=Q,
+        vocab_size=VOCAB,
+        num_slots=4,
+        admit_width=2,
+        harvest_width=2,
+        block_size=4,
+        prefix_pool_blocks=pool_blocks,
+        prefill_chunk=prefill_chunk,
+        prefill_chunks_per_pump=chunks_per_pump,
+    )
+
+
+def _params():
+    return _model_and_params()[2]
+
+
+def _mixed_prompts(n, seed=0, lo=2, hi=None, sort=True):
+    """Left-padded mixed-length prompts; sorted by length so admit
+    groups become length-homogeneous and leading-pad chunks actually
+    skip (a group-max decision — per-row RNG makes submission order
+    irrelevant to every row's bits, the engine's invariance contract)."""
+    rng = np.random.default_rng(seed)
+    hi = Q if hi is None else hi
+    ids = np.full((n, Q), EOS, np.int32)
+    mask = np.zeros((n, Q), np.int32)
+    for i in range(n):
+        real = int(rng.integers(lo, hi + 1))
+        ids[i, Q - real:] = rng.integers(1, 60, real)
+        mask[i, Q - real:] = 1
+    if sort:
+        order = np.argsort(mask.sum(axis=1))
+        ids, mask = ids[order], mask[order]
+    return ids, mask
+
+
+def _drive_rows(engine, ids, mask, key, pool=None, pump=False):
+    """Run a prompt set through the engine; returns {row: fields}.
+    ``pool`` plans prefix sharing just-in-time per admission wave (the
+    serving flow — a later wave reads the earlier wave's published
+    blocks once ready); ``pump`` uses the serving pump loop instead of
+    drive() (exercises the per-pump chunk budget path)."""
+    N = ids.shape[0]
+    engine.start_phase(_params(), key)
+    published_by_row = {}
+
+    def on_admitted(rows):
+        for row in rows:
+            blocks = published_by_row.pop(row, None)
+            if blocks:
+                pool.mark_ready(blocks)
+
+    engine._admit_listener = on_admitted if pool is not None else None
+    got = {}
+
+    def land(group):
+        arrs = {
+            k: np.asarray(group[k])
+            for k in ("tokens", "response_mask", "logprobs", "values")
+        }
+        for j, r in enumerate(group["rows"]):
+            assert r not in got
+            got[r] = {k: v[j] for k, v in arrs.items()}
+
+    if pool is None and not pump:
+        engine.submit(ids, mask)
+        for group in engine.drive(N):
+            land(group)
+        return got
+    fed = 0
+    while len(got) < N:
+        free = engine.free_capacity
+        if fed < N and free > 0:
+            take = min(free, engine.admit_width, N - fed)
+            shared_maps = publish_maps = None
+            if pool is not None:
+                plans = [
+                    pool.plan_admission(ids[i], mask[i])
+                    for i in range(fed, fed + take)
+                ]
+                shared_maps = np.stack([p.shared_map for p in plans])
+                publish_maps = np.stack([p.publish_map for p in plans])
+            rows = engine.submit(
+                ids[fed:fed + take], mask[fed:fed + take],
+                shared_maps=shared_maps, publish_maps=publish_maps,
+            )
+            if pool is not None:
+                for row, plan in zip(rows, plans):
+                    if plan.published:
+                        published_by_row[row] = plan.published
+            fed += take
+        for group in engine.pump():
+            land(group)
+    return got
+
+
+def _assert_rows_equal(a, b, exact_fp=True):
+    assert set(a) == set(b)
+    for r in a:
+        np.testing.assert_array_equal(a[r]["tokens"], b[r]["tokens"])
+        np.testing.assert_array_equal(
+            a[r]["response_mask"], b[r]["response_mask"]
+        )
+        if exact_fp:
+            # float32 CPU tier: the narrowed attention view and the
+            # chunked forward reproduce the monolithic bits exactly
+            # (masked columns' softmax weights underflow to exactly 0)
+            np.testing.assert_array_equal(a[r]["logprobs"], b[r]["logprobs"])
+            np.testing.assert_array_equal(a[r]["values"], b[r]["values"])
+        else:
+            np.testing.assert_allclose(
+                a[r]["logprobs"], b[r]["logprobs"], rtol=0, atol=1e-2
+            )
+            np.testing.assert_allclose(
+                a[r]["values"], b[r]["values"], rtol=0, atol=2e-2
+            )
+
+
+# ------------------------------- parity --------------------------------- #
+
+
+def test_chunked_matches_monolithic_mixed_lengths():
+    """The tentpole pin: chunked prefill is bitwise-identical to the
+    monolithic program on mixed-length left-padded prompts — INCLUDING
+    groups whose leading all-pad chunks were skipped (never computed:
+    their cache positions stay zero and every read of them is masked)."""
+    mono, chunked = _engine(0), _engine(4)
+    ids, mask = _mixed_prompts(8, seed=3)
+    key = jax.random.PRNGKey(7)
+    want = _drive_rows(mono, ids, mask, key)
+    got = _drive_rows(chunked, ids, mask, key)
+    _assert_rows_equal(want, got)
+    st = chunked.stats
+    assert st.prefill_chunks > 0
+    # length-sorted submission makes at least the shortest admit group
+    # skip its leading pad chunks — the compute-skipping acceptance
+    assert st.prefill_cols_skipped > 0
+    assert st.prefill_flops_saved > 0
+
+
+def test_chunked_sharing_matches_monolithic():
+    """Prefix sharing ON: pool-covered shared blocks are gathered, never
+    recomputed — and the result is still bitwise the monolithic+sharing
+    engine's. Full-length prompts with a common leading half (left-padded
+    prompts share iff they pad identically, docs/serving.md)."""
+    from trlx_tpu.serving.prefix_cache import PrefixBlockPool
+
+    mono_sh, chunked_sh = _engine(0, pool_blocks=16), _engine(4, pool_blocks=16)
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(1, 60, Q // 2).astype(np.int32)
+    N = 8
+    ids = rng.integers(1, 60, (N, Q)).astype(np.int32)
+    ids[:, : Q // 2] = prefix
+    mask = np.ones((N, Q), np.int32)
+    key = jax.random.PRNGKey(5)
+
+    def pool():
+        return PrefixBlockPool(16, mono_sh.block_size, mono_sh.n_blocks)
+
+    want = _drive_rows(mono_sh, ids, mask, key, pool=pool())
+    got = _drive_rows(chunked_sh, ids, mask, key, pool=pool())
+    _assert_rows_equal(want, got)
+    st = chunked_sh.stats
+    assert st.prefix_hit_blocks > 0  # sharing actually happened
+    # shared leading blocks were SKIPPED, not recomputed: the
+    # docs/serving.md caveat ("sharing buys HBM traffic, not prefill
+    # FLOPs") is closed — prefix_hit_rate is now also a FLOP number
+    assert st.prefill_cols_skipped > 0
+    assert st.prefill_flops_saved > 0
+
+
+def test_all_rows_shorter_than_one_chunk():
+    """The early-exit tail edge (the prefill mirror of the segmented
+    decode's all-finished-tail pins): every row of every admit group
+    fits inside the FINAL chunk, so every scan chunk skips — the group
+    pays exactly one chunk forward (finish), and the bits still match
+    the monolithic program."""
+    mono, chunked = _engine(0), _engine(4)
+    ids, mask = _mixed_prompts(4, seed=9, lo=1, hi=3, sort=False)
+    key = jax.random.PRNGKey(13)
+    want = _drive_rows(mono, ids, mask, key)
+    got = _drive_rows(chunked, ids, mask, key)
+    _assert_rows_equal(want, got)
+    st = chunked.stats
+    n_groups = st.prefills
+    n_scan = chunked.n_prefill_chunks - 1
+    assert st.prefill_chunks == n_groups  # ONLY the finish chunks ran
+    assert st.prefill_cols_skipped == (
+        n_groups * n_scan * chunked.prefill_chunk
+    )
+
+
+def test_pump_chunk_budget_interleaves_decode():
+    """Sarathi-style stall-free admission: with a one-chunk-per-pump
+    budget, an admission burst's prefill spreads across pump iterations
+    with decode steps in between — strictly more decode dispatches than
+    the inline admission path while rows are identical bitwise, and a
+    mid-prefill weight push is deferred to the group boundary."""
+    chunked, budgeted = _engine(4), _engine(4, chunks_per_pump=1)
+    ids, mask = _mixed_prompts(8, seed=21, lo=Q, hi=Q)  # all full-length
+    key = jax.random.PRNGKey(17)
+    want = _drive_rows(chunked, ids, mask, key, pump=True)
+    got = _drive_rows(budgeted, ids, mask, key, pump=True)
+    _assert_rows_equal(want, got)
+    assert budgeted.stats.prefill_chunks == chunked.stats.prefill_chunks
+    # the budgeted loop needed MORE pump iterations (each a decode step
+    # once slots are busy) to cover the same admissions
+    assert budgeted.stats.decode_steps > chunked.stats.decode_steps
+
+    # mid-prefill push deferral: stage a push while a group is in
+    # flight; it must not apply until the group completes
+    budgeted.start_phase(_params(), key)
+    budgeted.submit(ids[:2], mask[:2])
+    budgeted.pump()  # begins the admission, dispatches one chunk
+    assert budgeted._inflight_admission is not None
+    budgeted.push_weights(_params(), version=5)
+    budgeted.pump()
+    assert budgeted.param_version in (0, 5)
+    if budgeted._inflight_admission is not None:
+        assert budgeted.param_version == 0  # still deferred mid-group
+    while budgeted._inflight_admission is not None:
+        budgeted.pump()
+    budgeted.pump()  # group boundary: the push applies
+    assert budgeted.param_version == 5
+
+
+def test_request_marks_carry_chunk_offsets():
+    """Serving observability: a traced request harvested through the
+    chunked path carries per-chunk-window dispatch offsets in its marks
+    (the serve/prefill span attributes --trace-report reads)."""
+    chunked = _engine(4)
+    chunked.trace_requests = True
+    try:
+        ids, mask = _mixed_prompts(2, seed=4, lo=Q, hi=Q, sort=False)
+        chunked.start_phase(_params(), jax.random.PRNGKey(3))
+        rows = chunked.submit(ids, mask)
+        for _ in chunked.drive(2):
+            pass
+        record = chunked.pop_request_record(rows[0])
+        offs = record["marks"]["prefill_chunk_offsets"]
+        assert len(offs) >= 1
+        assert all(
+            set(o) == {"col", "ms"} and o["ms"] >= 0.0 for o in offs
+        )
+        cols = [o["col"] for o in offs]
+        assert cols == sorted(cols)
+        assert cols[-1] == (chunked.n_prefill_chunks - 1) * chunked.prefill_chunk
+    finally:
+        chunked.trace_requests = False
+
+
+# ------------------------------- FLOPs ---------------------------------- #
+
+
+def test_chunked_flops_strictly_below_monolithic():
+    """The engine-7 acceptance: the chunked pair's exact dot-FLOP count
+    (scan with EVERY chunk's cond at the run branch + finish) is
+    strictly below the monolithic prefill at the same shape — the
+    prompt-wide attention view alone guarantees it, before any chunk is
+    skipped at runtime. Also pins the flops-saved gauge's per-chunk cost
+    as a real traced number."""
+    from trlx_tpu.analysis.resource_audit import count_flops
+
+    mono, chunked = _engine(0), _engine(4)
+    params_sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), _params()
+    )
+    state_sds = jax.eval_shape(mono._make_state)
+    A = mono.admit_width
+    n_scan = chunked.n_prefill_chunks - 1
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    f_mono = count_flops(
+        jax.make_jaxpr(mono.prefill_jit)(
+            params_sds, state_sds, i32(A), i32(A, Q), i32(A, Q),
+            i32(A), i32(A), key,
+        ).jaxpr
+    )
+    f_chunks = count_flops(
+        jax.make_jaxpr(chunked.prefill_chunks_jit)(
+            params_sds, state_sds, i32(A), i32(A, Q), i32(A, Q),
+            i32(A), jax.ShapeDtypeStruct((n_scan,), jnp.bool_),
+        ).jaxpr
+    )
+    f_finish = count_flops(
+        jax.make_jaxpr(chunked.prefill_finish_jit)(
+            params_sds, state_sds, i32(A), i32(A, Q), i32(A, Q),
+            i32(A), i32(A), key,
+        ).jaxpr
+    )
+    assert f_chunks + f_finish < f_mono
+    # the saved-FLOPs gauge prices one skipped chunk with the SAME
+    # counter over the same traced program
+    chunked.start_phase(_params(), jax.random.PRNGKey(1))
+    assert chunked._chunk_flop_cost() == pytest.approx(f_chunks / n_scan)
+
+
+def test_budget_lockfile_pins_chunked_below_monolithic():
+    """The committed resource lockfile (analysis/budgets.json) carries
+    the chunked subjects, and at the audit shape the chunked pair sits
+    strictly below the monolithic entry — for the trainer engine AND
+    the sharing serving variant."""
+    import json
+
+    from trlx_tpu.analysis.resource_audit import default_budgets_path
+
+    programs = json.load(open(default_budgets_path()))["programs"]
+    for suffix in ("", "_shared"):
+        mono = programs[f"ppo.engine_prefill{suffix}"]["flops"]
+        ck = programs[f"ppo.engine_prefill_chunked{suffix}"]["flops"]
+        fin = programs[f"ppo.engine_prefill_finish{suffix}"]["flops"]
+        assert ck + fin < mono, suffix
+
+
+def test_engine_serves_local_attention_gpt_neo():
+    """Ride-along regression pin: ``gpt_neo.local_causal_bias`` now
+    supports the engine's per-row [B] ``cache_index`` offsets (the
+    vector-offset contract ``ops/attention.py::causal_bias`` already
+    had). Previously ANY GPT-Neo config with a local layer crashed the
+    continuous engine's decode_step at trace time — the latent gap the
+    chunked-prefill family sweep exposed. Pins engine (monolithic AND
+    chunked) against the fixed sampler bitwise on a global+local
+    config."""
+    from trlx_tpu.models.gpt_neo import (
+        GPTNeoConfig,
+        GPTNeoModel,
+        init_gpt_neo_cache,
+    )
+    from trlx_tpu.models.heads import CausalLMWithValueHead
+    from trlx_tpu.ops.sampling import make_row_keys, make_sampler
+
+    cfg = GPTNeoConfig(
+        vocab_size=VOCAB, max_position_embeddings=64, hidden_size=32,
+        num_layers=2, num_heads=2, window_size=8,
+        attention_layers=("global", "local"), dtype="float32",
+    )
+    model = CausalLMWithValueHead(cfg, backbone_cls=GPTNeoModel)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    def apply_fn(p, input_ids, attention_mask=None, position_ids=None,
+                 cache=None, cache_index=None, last_only=False,
+                 skip_heads=False):
+        return model.apply(
+            {"params": p}, input_ids, attention_mask=attention_mask,
+            position_ids=position_ids, cache=cache,
+            cache_index=cache_index, last_only=last_only,
+            skip_heads=skip_heads,
+        )
+
+    gen = GenerationConfig(
+        max_new_tokens=R, min_new_tokens=1, eos_token_id=EOS,
+        pad_token_id=EOS, per_row_rng=True,
+    )
+    init_fn = functools.partial(init_gpt_neo_cache, cfg)
+    common = dict(
+        apply_fn=apply_fn, init_cache_fn=init_fn, gen_config=gen,
+        query_length=Q, vocab_size=VOCAB, num_slots=4, admit_width=2,
+        harvest_width=2, block_size=4,
+    )
+    engines = {
+        "mono": ContinuousBatchingEngine(**common),
+        "chunked": ContinuousBatchingEngine(**common, prefill_chunk=4),
+    }
+    sampler = jax.jit(make_sampler(apply_fn, init_fn, gen, Q))
+    ids, mask = _mixed_prompts(4, seed=6, lo=3, sort=False)
+    key = jax.random.PRNGKey(3)
+    fixed = sampler(
+        params, jnp.asarray(ids), jnp.asarray(mask),
+        make_row_keys(key, jnp.arange(4)),
+    )
+    want_tokens = np.asarray(fixed.tokens)
+    for engine in engines.values():
+        engine.start_phase(params, key)
+        engine.submit(ids, mask)
+        got = {}
+        for group in engine.drive(4):
+            for j, r in enumerate(group["rows"]):
+                got[r] = np.asarray(group["tokens"])[j]
+        for r in range(4):
+            np.testing.assert_array_equal(got[r], want_tokens[r])
+
+
+# ---------------------------- mesh variants ------------------------------ #
+
+
+@pytest.mark.slow
+def test_chunked_parity_on_mixed_mesh():
+    """Nightly: chunked <-> monolithic parity through the TRAINER's
+    engine construction path on the mixed fsdp×tp mesh — tokens/masks
+    bitwise, logprobs/values at the established bf16 resolution (the
+    same caveat as every engine parity pin on tp-sharded meshes)."""
+    from trlx_tpu.analysis import harness
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    def build(rollout):
+        cfg = harness.tiny_config_dict(
+            "ppo", mesh={"dp": 2, "fsdp": 2, "tp": 2}
+        )
+        cfg["method"]["num_rollouts"] = 16
+        cfg["method"]["chunk_size"] = 8
+        cfg["train"]["batch_size"] = 8
+        cfg["train"]["rollout"] = rollout
+        cfg["method"]["gen_kwargs"]["min_new_tokens"] = 1
+        return PPOTrainer(TRLConfig.from_dict(cfg))
+
+    base = {
+        "engine": "continuous", "slots": 16, "admit_width": 8,
+        "harvest_width": 8, "block_size": 4, "per_row_rng": True,
+    }
+    mono_t = build(dict(base))
+    chunk_t = build(dict(base, prefill_chunk=4))
+    assert chunk_t.rollout_engine_obj.prefill_chunk > 0
+    qlen = mono_t.query_length
+    rng = np.random.default_rng(2)
+    ids = rng.integers(1, 30, (16, qlen)).astype(np.int32)
+    mask = np.ones((16, qlen), np.int32)
+    for i in range(16):
+        real = int(rng.integers(2, qlen + 1))
+        mask[i, : qlen - real] = 0
+        ids[i, : qlen - real] = 31
+    rowsets = []
+    for tr in (mono_t, chunk_t):
+        tr.rng = jax.random.PRNGKey(42)
+        tr.reset_rollout_phase()
+        engine = tr.rollout_engine_obj
+        engine.start_phase(tr.rollout_params(), tr.rollout_phase_key())
+        engine.submit(ids, mask)
+        got = {}
+        for group in engine.drive(16):
+            arrs = {
+                k: np.asarray(group[k])
+                for k in ("tokens", "response_mask", "logprobs", "values")
+            }
+            for j, r in enumerate(group["rows"]):
+                got[r] = {k: v[j] for k, v in arrs.items()}
+        rowsets.append(got)
+    _assert_rows_equal(rowsets[0], rowsets[1], exact_fp=False)

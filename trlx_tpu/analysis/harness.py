@@ -680,7 +680,132 @@ def _trace_engine_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram
             ),
             def_site=callable_def_site(engine.refill_jit),
         ),
-    ] + _trace_serving_engine_programs(trainer, engine, kind, mesh_shape)
+    ] + _trace_chunked_prefill_programs(
+        trainer, engine, kind, mesh_shape, shared=False
+    ) + _trace_serving_engine_programs(trainer, engine, kind, mesh_shape)
+
+
+def _trace_chunked_prefill_programs(
+    trainer, base_engine, kind: str, mesh_shape, shared: bool
+) -> List[TracedProgram]:
+    """Trace the CHUNKED prefill variant (``rollout.prefill_chunk > 0``,
+    docs/inference.md "Chunked prefill"): the same engine geometry with
+    the monolithic admission prefill replaced by the
+    ``prefill_chunks`` scan (lax.cond-gated block-aligned prompt-column
+    chunks) plus the always-run ``prefill_finish`` program. Separate
+    subjects with their own resource-budget entries — the default
+    engine's ``engine_prefill`` stays byte-identical, and the engine-7
+    FLOP count pins the chunked pair strictly below the monolithic
+    entry at the audit shape (attention runs on the prompt-wide view,
+    never the full Q+R capacity).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.inference.engine import ContinuousBatchingEngine
+    from trlx_tpu.parallel.mesh import batch_sharding
+
+    engine = ContinuousBatchingEngine(
+        apply_fn=base_engine._apply_fn,
+        init_cache_fn=base_engine._init_cache_fn,
+        gen_config=base_engine.gen_config,
+        query_length=base_engine.Q,
+        vocab_size=base_engine.vocab_size,
+        num_slots=base_engine.num_slots,
+        admit_width=base_engine.admit_width,
+        harvest_width=base_engine.harvest_width,
+        block_size=base_engine.block_size,
+        mesh=base_engine.mesh,
+        param_shardings=base_engine._param_shardings,
+        cache_sharding=base_engine._cache_sharding,
+        with_values=base_engine.with_values,
+        prefix_pool_blocks=(
+            max(2, base_engine.Q // base_engine.block_size)
+            if shared
+            else 0
+        ),
+        stream_taps=shared,
+        prefill_chunk=max(1, base_engine.Q // 2),
+    )
+    axes = set(trainer.mesh.axis_names)
+    state_sds = jax.eval_shape(engine._make_state)
+    params_sds = _sds(trainer.state.params)
+    A, Q, nb = engine.admit_width, engine.Q, engine.n_blocks
+    n_scan = engine.n_prefill_chunks - 1
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+    key_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    state_sh = engine.state_sharding()
+    batch_sh = batch_sharding(trainer.mesh)
+    params_sh = trainer.state_shardings.params
+    suffix = "_shared" if shared else ""
+
+    chunks_args = (
+        params_sds, state_sds, i32(A), i32(A, Q), i32(A, Q), i32(A),
+        jax.ShapeDtypeStruct((max(1, n_scan),), jnp.bool_),
+    )
+    chunks_prefixes = (
+        "params", "state", "slots", "prompt_ids", "prompt_mask",
+        "turns", "need",
+    )
+    chunks_shardings = (
+        params_sh, state_sh, None, batch_sh, batch_sh, None, None,
+    )
+    finish_args = (
+        params_sds, state_sds, i32(A), i32(A, Q), i32(A, Q), i32(A),
+        i32(A), key_sds,
+    )
+    finish_prefixes = (
+        "params", "state", "slots", "prompt_ids", "prompt_mask",
+        "rows", "turns", "phase_key",
+    )
+    finish_shardings = (
+        params_sh, state_sh, None, batch_sh, batch_sh, None, None, None,
+    )
+    if shared:
+        chunks_args += (i32(A, nb), i32(A, nb))
+        chunks_prefixes += ("shared_map", "publish_map")
+        chunks_shardings += (None, None)
+        finish_args += (i32(A, nb), i32(A, nb))
+        finish_prefixes += ("shared_map", "publish_map")
+        finish_shardings += (None, None)
+
+    out: List[TracedProgram] = []
+    if engine.prefill_chunks_jit is not None and n_scan > 0:
+        out.append(
+            TracedProgram(
+                subject=f"{kind}.engine_prefill_chunked{suffix}",
+                closed_jaxpr=jax.make_jaxpr(engine.prefill_chunks_jit)(
+                    *chunks_args
+                ),
+                mesh_axes=axes,
+                input_paths=flat_input_paths(
+                    *chunks_args, prefixes=chunks_prefixes
+                ),
+                mesh_shape=mesh_shape,
+                input_divisors=flat_sharding_divisors(
+                    chunks_args, chunks_shardings
+                ),
+                def_site=callable_def_site(engine.prefill_chunks_jit),
+            )
+        )
+    out.append(
+        TracedProgram(
+            subject=f"{kind}.engine_prefill_finish{suffix}",
+            closed_jaxpr=jax.make_jaxpr(engine.prefill_finish_jit)(
+                *finish_args
+            ),
+            mesh_axes=axes,
+            input_paths=flat_input_paths(
+                *finish_args, prefixes=finish_prefixes
+            ),
+            mesh_shape=mesh_shape,
+            input_divisors=flat_sharding_divisors(
+                finish_args, finish_shardings
+            ),
+            def_site=callable_def_site(engine.prefill_finish_jit),
+        )
+    )
+    return out
 
 
 def _trace_serving_engine_programs(
@@ -814,7 +939,9 @@ def _trace_serving_engine_programs(
             ),
             def_site=callable_def_site(serving_engine.release_jit),
         ),
-    ]
+    ] + _trace_chunked_prefill_programs(
+        trainer, serving_engine, kind, mesh_shape, shared=True
+    )
 
 
 def trace_all(

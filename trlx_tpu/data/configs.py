@@ -236,7 +236,12 @@ class TrainConfig:
     # Per-row token-identical to the fixed sampler under per-row RNG
     # (tests/test_inference_engine.py). Causal PPO-family trainers only
     # (no pp mesh axis, no grouped/GRPO sampling yet); "fixed" is the
-    # default and the parity baseline.
+    # default and the parity baseline. "prefill_chunk" (> 0) runs the
+    # engine's admission prefill as need-gated block-aligned prompt
+    # chunks (skips leading pad + prefix-pool-covered blocks; bitwise
+    # vs the monolithic program — docs/inference.md "Chunked prefill"),
+    # and "prefill_chunks_per_pump" bounds chunk forwards per serving
+    # pump (stall-free admission under bursts).
     rollout: Dict[str, Any] = field(default_factory=dict)
     # Multi-tenant serving tier (trlx_tpu/serving, docs/serving.md),
     # parsed into trlx_tpu.serving.ServingConfig and consumed by

@@ -65,6 +65,26 @@ class RolloutEngineConfig:
         (``None`` = only when ``engine == "continuous"``, which always
         samples per-row). The parity tests run the fixed baseline with
         ``per_row_rng: true``.
+    :param prefill_chunk: chunked-prefill width in prompt columns
+        (docs/inference.md "Chunked prefill"). ``> 0`` replaces the
+        engine's monolithic admission prefill with a scan over
+        block-aligned prompt-column chunks whose ``lax.cond`` skips
+        chunks no admitted row needs — leading all-pad columns of
+        left-padded prompts and blocks served from the shared-prefix
+        pool — so prefill compute scales with the group's real prompt
+        length, and prefix sharing saves prefill FLOPs, not just HBM
+        traffic. Rounded to a block-aligned divisor of the query length
+        (``inference/kv_cache.py::choose_prefill_chunk``). Chunked and
+        monolithic prefill are token/mask-bitwise-identical
+        (logprobs/values at the engine's established bf16 resolution).
+        0 — the default — keeps the monolithic program byte-identical.
+    :param prefill_chunks_per_pump: serving-pump chunk budget
+        (Sarathi-style stall-free admission; needs ``prefill_chunk``):
+        one ``pump()`` dispatches at most this many prefill-chunk
+        forwards before advancing decode, so an admission burst
+        interleaves with decode steps instead of stalling them. 0 =
+        unbounded; the trainer collect loop (``drive``) always completes
+        an admission inline.
     """
 
     engine: str = "fixed"
@@ -74,6 +94,8 @@ class RolloutEngineConfig:
     block_size: int = 16
     poll_interval: int = 1
     per_row_rng: Optional[bool] = None
+    prefill_chunk: int = 0
+    prefill_chunks_per_pump: int = 0
 
     def __post_init__(self):
         if self.engine not in ROLLOUT_ENGINES:
@@ -90,6 +112,23 @@ class RolloutEngineConfig:
                 f"train.rollout poll_interval={self.poll_interval} must "
                 "be >= 1"
             )
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"train.rollout prefill_chunk={self.prefill_chunk} must "
+                "be >= 0 (0 = monolithic prefill)"
+            )
+        if self.prefill_chunks_per_pump < 0:
+            raise ValueError(
+                "train.rollout prefill_chunks_per_pump="
+                f"{self.prefill_chunks_per_pump} must be >= 0 "
+                "(0 = unbounded)"
+            )
+        if self.prefill_chunks_per_pump and not self.prefill_chunk:
+            raise ValueError(
+                "train.rollout prefill_chunks_per_pump needs chunked "
+                "prefill (prefill_chunk > 0) — the monolithic program "
+                "has nothing to budget"
+            )
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RolloutEngineConfig":
@@ -103,7 +142,7 @@ class RolloutEngineConfig:
             )
         for name in (
             "slots", "admit_width", "harvest_width", "block_size",
-            "poll_interval",
+            "poll_interval", "prefill_chunk", "prefill_chunks_per_pump",
         ):
             if name in d and d[name] is not None:
                 d[name] = int(d[name])

@@ -152,6 +152,13 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
       ``serve/request`` span chain and the span ring dropped NOTHING
       (an evicting ring silently truncates traces — the assert is the
       capacity canary for telemetry.ring_size);
+    - **chunked prefill**: the scenario runs with
+      ``rollout.prefill_chunk`` enabled and a per-pump chunk budget
+      (``prefill_chunks_per_pump`` — Sarathi-style stall-free
+      admission), and must report ``engine/prefill_chunks > 0`` while
+      staying bitwise-served (the parity contract is pinned in
+      tests/test_chunked_prefill.py; here the gate is that the chunked
+      serving path carries real multi-tenant traffic cleanly);
     - **zero health events** on this clean run.
 
     ``span_log`` exports the whole span stream (phase + request spans
@@ -168,6 +175,10 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
     scfg = harness.tiny_config_dict("ppo", mesh=mesh)
     scfg["train"]["rollout"] = {
         "slots": 4, "admit_width": 2, "harvest_width": 2, "block_size": 4,
+        # chunked prefill, serving tier: admission prefill runs as
+        # need-gated prompt-column chunks, at most one chunk forward
+        # per pump (stall-free admission under bursts)
+        "prefill_chunk": 4, "prefill_chunks_per_pump": 1,
     }
     server = InferenceServer(
         TRLConfig.from_dict(scfg),
@@ -257,6 +268,9 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
         "scheduler_throttled_rounds": stats["scheduler/throttled_rounds"],
         "prefix_hit_rate": stats["engine/prefix_hit_rate"],
         "prefix_blocks_saved": stats["engine/prefix_blocks_saved"],
+        "prefill_chunks": stats["engine/prefill_chunks"],
+        "prefill_cols_skipped": stats["engine/prefill_cols_skipped"],
+        "prefill_flops_saved": stats["engine/prefill_flops_saved"],
         "released_placeholders": stats["engine/released"],
         "request_spans": len(request_spans),
         "spans_dropped": int(tracer.dropped),
@@ -307,6 +321,11 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
         )
     if not stats["engine/prefix_hit_rate"] > 0:  # tpu-lint: disable=host-branch
         failures.append("prefix sharing produced zero hits")
+    if not stats["engine/prefill_chunks"] > 0:  # tpu-lint: disable=host-branch
+        failures.append(
+            "chunked prefill never ran (engine/prefill_chunks == 0) "
+            "despite rollout.prefill_chunk being set"
+        )
     if telemetry.get_metrics().enabled:
         for tenant in ("gold", "bronze"):
             key = f"serve/queue_wait_ms[tenant={tenant}]"
@@ -337,7 +356,10 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
         "mt-smoke PASS: priority ordering, quota-throttle-no-starve, "
         f"streamed TTFT {ttft_stream_ms:.0f}ms < harvest "
         f"{ttft_harvest_ms:.0f}ms, prefix hit rate "
-        f"{stats['engine/prefix_hit_rate']:.2f}, zero health events",
+        f"{stats['engine/prefix_hit_rate']:.2f}, "
+        f"{stats['engine/prefill_chunks']:.0f} prefill chunks "
+        f"({stats['engine/prefill_cols_skipped']:.0f} cols skipped), "
+        "zero health events",
         file=sys.stderr,
     )
     return 0

@@ -119,6 +119,14 @@ class EngineStats:
     done_polls: int = 0  # [B]-bool device->host fetches actually paid
     weight_pushes: int = 0  # mid-generation behavior refreshes applied
     released: int = 0  # placeholder rows force-finished on admission
+    # chunked prefill (rollout.prefill_chunk > 0): chunks actually RUN
+    # (the finish chunk included), prompt columns whose forward was
+    # skipped (leading pad + pool-covered shared blocks), and the exact
+    # dot-FLOPs those skipped columns would have cost (per-chunk cost
+    # from the traced program — engine-7's counter, not an estimate)
+    prefill_chunks: int = 0
+    prefill_cols_skipped: int = 0
+    prefill_flops_saved: float = 0.0
     # cross-request prefix sharing (serving tier): block-granular lookup
     # accounting per admitted real row — hits are blocks served from the
     # shared pool WITHOUT this row publishing them (true reuse), saved
@@ -155,6 +163,9 @@ class EngineStats:
             "engine/done_polls": float(self.done_polls),
             "engine/weight_pushes": float(self.weight_pushes),
             "engine/released": float(self.released),
+            "engine/prefill_chunks": float(self.prefill_chunks),
+            "engine/prefill_cols_skipped": float(self.prefill_cols_skipped),
+            "engine/prefill_flops_saved": float(self.prefill_flops_saved),
             "engine/prefix_hit_rate": round(self.prefix_hit_rate, 4),
             "engine/prefix_blocks_saved": float(self.prefix_blocks_saved),
         }
@@ -196,6 +207,34 @@ class ContinuousBatchingEngine:
         per-request queues (:mod:`trlx_tpu.serving.streaming`) the step
         they are produced instead of at harvest. Off (the default) keeps
         the trainer-path program unchanged.
+    :param prefill_chunk: chunked-prefill width in prompt columns
+        (``rollout.prefill_chunk``; rounded by
+        :func:`~trlx_tpu.inference.kv_cache.choose_prefill_chunk` to a
+        block-aligned divisor of Q). ``> 0`` replaces the monolithic
+        admission prefill with a scan over block-aligned prompt-column
+        chunks, each wrapped in a ``lax.cond`` that SKIPS the forward
+        when no row in the admit group needs it — leading all-pad
+        columns of left-padded prompts (the mirror of PR-3's segmented
+        decode early-exit: compute scales with
+        ``ceil(max_real_len/chunk)`` instead of Q) and blocks served
+        read-only from the shared-prefix pool (prefix sharing becomes a
+        prefill-FLOP win, not just an HBM one). Chunk forwards attend a
+        prompt-wide (Q) cache view instead of the full Q+R capacity —
+        masked decode-region columns carry exactly-zero softmax weight,
+        so the narrowing is bitwise-safe and the chunked prefill is
+        token/mask-identical to the monolithic program (logprobs/values
+        at the established bf16 resolution). 0 — the default, and the
+        trainer collect path unless configured — keeps the monolithic
+        program byte-identical.
+    :param prefill_chunks_per_pump: with ``prefill_chunk > 0``, bound
+        how many chunk forwards one :meth:`pump` iteration dispatches
+        (Sarathi-style stall-free admission): a large admission burst
+        spreads its prefill across pump iterations, each followed by a
+        decode step for the already-running slots, instead of stalling
+        decode for the whole burst. 0 = unbounded (a group's whole
+        prefill dispatches in one pump, as the monolithic path does).
+        :meth:`drive` (the trainer collect loop) always completes an
+        admission inline regardless.
     """
 
     def __init__(
@@ -217,7 +256,11 @@ class ContinuousBatchingEngine:
         with_values: bool = True,
         prefix_pool_blocks: int = 0,
         stream_taps: bool = False,
+        prefill_chunk: int = 0,
+        prefill_chunks_per_pump: int = 0,
     ):
+        from trlx_tpu.inference.kv_cache import choose_prefill_chunk
+
         self.gen_config = dataclasses.replace(gen_config, per_row_rng=True)
         self.Q = int(query_length)
         self.R = int(self.gen_config.max_new_tokens)
@@ -228,6 +271,24 @@ class ContinuousBatchingEngine:
         self.n_blocks = self.capacity // self.block_size
         self.prefix_pool_blocks = int(prefix_pool_blocks)
         self.stream_taps = bool(stream_taps)
+        self.prefill_chunk = choose_prefill_chunk(
+            self.Q, int(prefill_chunk), self.block_size
+        )
+        self.n_prefill_chunks = (
+            self.Q // self.prefill_chunk if self.prefill_chunk else 0
+        )
+        self.prefill_chunks_per_pump = int(prefill_chunks_per_pump)
+        if self.prefill_chunks_per_pump < 0:
+            raise ValueError(
+                f"prefill_chunks_per_pump={prefill_chunks_per_pump} "
+                "must be >= 0 (0 = unbounded)"
+            )
+        if self.prefill_chunks_per_pump and not self.prefill_chunk:
+            raise ValueError(
+                "prefill_chunks_per_pump needs chunked prefill "
+                "(prefill_chunk > 0) — there is nothing to budget on the "
+                "monolithic program"
+            )
         #: host callback ``{row: token_id} -> None`` fired per decode
         #: step with the step's live emissions (requires stream_taps)
         self.token_sink: Optional[Callable[[Dict[int, int]], None]] = None
@@ -266,10 +327,18 @@ class ContinuousBatchingEngine:
                 f"{shard} data shards of the mesh"
             )
 
+        fn_params = inspect.signature(apply_fn).parameters
         self._prefill_kwargs = (
-            {"last_only": True}
-            if "last_only" in inspect.signature(apply_fn).parameters
-            else {}
+            {"last_only": True} if "last_only" in fn_params else {}
+        )
+        # non-final prefill chunks only want the KV-cache side effect:
+        # an apply_fn supporting ``skip_heads`` pays zero LM/value-head
+        # FLOPs per chunk (models/heads.py); otherwise fall back to the
+        # single-row last_only head
+        self._chunk_kwargs = (
+            {"skip_heads": True}
+            if "skip_heads" in fn_params
+            else dict(self._prefill_kwargs)
         )
         self._param_shardings = param_shardings
         self._cache_sharding = cache_sharding
@@ -285,6 +354,12 @@ class ContinuousBatchingEngine:
         self._free: List[int] = []
         self._busy_rows: Dict[int, int] = {}  # slot -> row index
         self._done_slots: List[int] = []
+        # chunked prefill: the admission group currently mid-prefill
+        # (slots reserved, some chunk windows dispatched) — the serving
+        # pump advances it by at most ``prefill_chunks_per_pump`` chunk
+        # forwards per iteration; drive() completes it inline
+        self._inflight_admission: Optional[Dict[str, Any]] = None
+        self._chunk_flops: Optional[float] = None  # lazy exact per-chunk cost
         self._recycle_counts = np.zeros(self.num_slots, np.int64)
         self._next_row = 0
         # behavior-policy versioning (async actor–learner): every slot
@@ -451,27 +526,18 @@ class ContinuousBatchingEngine:
         sharing = self.prefix_pool_blocks > 0
         from trlx_tpu.inference.kv_cache import SHARED_POOL_KEYS
 
-        def prefill(
-            params,
-            state: EngineState,
-            slot_ids,  # [A] int32; num_slots = dummy (writes drop)
-            prompt_ids,  # [A, Q] int32 left-padded
-            prompt_mask,  # [A, Q] int32
-            row_index,  # [A] int32 global draw index
-            table_turns,  # [A] int32 block-table rotation per slot
-            phase_key,  # [2] uint32
-            shared_map=None,  # [A, nb] int32 pool block per logical
-            publish_map=None,  # block (-1 = private / no publish)
-        ) -> EngineState:
-            A = prompt_ids.shape[0]
-            row_keys = make_row_keys(phase_key, row_index)
-            n_real = jnp.sum(prompt_mask, axis=-1).astype(jnp.int32)
-
-            # recycled slots get a rotated block table: physical block
-            # reuse order differs from logical order, so table
-            # resolution is exercised on every refill
+        def slice_group_cache(state, slot_ids, table_turns,
+                              shared_map, publish_map):
+            """The admitted slots' cache slice with freshly-rotated block
+            tables (+ the group's share/publish maps and the whole pool
+            when sharing) — shared by the monolithic prefill and every
+            chunked-prefill call (one implementation, one parity
+            surface). Recycled slots get a rotated table: physical block
+            reuse order differs from logical order, so table resolution
+            is exercised on every refill."""
             new_tables = (
-                (jnp.arange(nb, dtype=jnp.int32)[None, :] + table_turns[:, None])
+                (jnp.arange(nb, dtype=jnp.int32)[None, :]
+                 + table_turns[:, None])
                 % nb
             )
 
@@ -493,7 +559,46 @@ class ContinuousBatchingEngine:
                     sl["publish_tables"] = publish_map
                 return sl
 
-            cache_slice = tuple(slice_layer(l) for l in state.cache)
+            return tuple(slice_layer(l) for l in state.cache)
+
+        def merge_group_cache(state, slot_ids, cache_out):
+            def merge_layer(full, sl):
+                def one(k):
+                    if k in SHARED_POOL_KEYS:
+                        # global pool: take the (possibly published-to)
+                        # pool wholesale, never slot-scattered
+                        return sl[k].astype(full[k].dtype)
+                    return (
+                        full[k]
+                        .at[slot_ids]
+                        .set(sl[k].astype(full[k].dtype), mode="drop")
+                    )
+
+                return {k: one(k) for k in full}
+
+            return tuple(
+                merge_layer(f, s) for f, s in zip(state.cache, cache_out)
+            )
+
+        def prefill(
+            params,
+            state: EngineState,
+            slot_ids,  # [A] int32; num_slots = dummy (writes drop)
+            prompt_ids,  # [A, Q] int32 left-padded
+            prompt_mask,  # [A, Q] int32
+            row_index,  # [A] int32 global draw index
+            table_turns,  # [A] int32 block-table rotation per slot
+            phase_key,  # [2] uint32
+            shared_map=None,  # [A, nb] int32 pool block per logical
+            publish_map=None,  # block (-1 = private / no publish)
+        ) -> EngineState:
+            A = prompt_ids.shape[0]
+            row_keys = make_row_keys(phase_key, row_index)
+            n_real = jnp.sum(prompt_mask, axis=-1).astype(jnp.int32)
+
+            cache_slice = slice_group_cache(
+                state, slot_ids, table_turns, shared_map, publish_map
+            )
             cache_mask = concat_cols(
                 prompt_mask, jnp.zeros((A, R), prompt_mask.dtype)
             )
@@ -517,23 +622,7 @@ class ContinuousBatchingEngine:
             else:
                 finished0 = jnp.zeros((A,), bool)
 
-            def merge_layer(full, sl):
-                def one(k):
-                    if k in SHARED_POOL_KEYS:
-                        # global pool: take the (possibly published-to)
-                        # pool wholesale, never slot-scattered
-                        return sl[k].astype(full[k].dtype)
-                    return (
-                        full[k]
-                        .at[slot_ids]
-                        .set(sl[k].astype(full[k].dtype), mode="drop")
-                    )
-
-                return {k: one(k) for k in full}
-
-            new_cache = tuple(
-                merge_layer(f, s) for f, s in zip(state.cache, out["cache"])
-            )
+            new_cache = merge_group_cache(state, slot_ids, out["cache"])
 
             def put(field, rows):
                 return field.at[slot_ids].set(
@@ -669,6 +758,165 @@ class ContinuousBatchingEngine:
             finished = state.finished.at[slot_ids].set(True, mode="drop")
             return dataclasses.replace(state, finished=finished)
 
+        # ------------- chunked prefill (rollout.prefill_chunk) ------------- #
+        # The monolithic `prefill` above pays full prompt-capacity
+        # attention FLOPs for every admitted row. These two programs
+        # replace it when prefill_chunk > 0:
+        #
+        # - `prefill_chunks`: lax.scan over the first n_chunks-1
+        #   block-aligned prompt-column chunks, each under a lax.cond
+        #   gated by the host-computed `need` vector — the run branch
+        #   forwards W columns (heads skipped) and writes their KV
+        #   through the block tables; the skip branch is the identity.
+        #   With LEFT-padded prompts the skippable chunks are the
+        #   LEADING ones (all-pad columns before the group's longest
+        #   row starts, and blocks served read-only from the shared
+        #   prefix pool), so this is the mirror of the segmented
+        #   decode's early-exit tail: compute scales with
+        #   ceil(max_real_len / W), not Q.
+        # - `prefill_finish`: the final chunk, always run (every
+        #   left-padded row's last real column lives there), producing
+        #   logits_last/value_last and seeding the slot fields.
+        #
+        # Both pass the PROMPT-WIDE mask (width Q, not capacity) as the
+        # attention view (ops/attention.py mask-width contract): prompt
+        # queries never attend the decode region, whose masked columns
+        # carry exactly-zero softmax weight in the monolithic program —
+        # dropping them is bitwise-safe for tokens/masks and shrinks the
+        # static attention FLOPs from Q·(Q+R) to Q·Q even before any
+        # chunk is skipped. Skipped chunks leave their cache positions
+        # zero; every read of those positions is masked (pad) or
+        # overlaid from the shared pool, and a masked column's softmax
+        # weight underflows to exactly 0.0 — so chunked and monolithic
+        # prefill agree bitwise on tokens/masks (logprobs/values at the
+        # established bf16 resolution; tests/test_chunked_prefill.py).
+        W = self.prefill_chunk
+        n_pc = self.n_prefill_chunks
+        n_scan_chunks = max(0, n_pc - 1)
+        chunk_kwargs = self._chunk_kwargs
+
+        def prefill_chunks(
+            params,
+            state: EngineState,
+            slot_ids,  # [A] int32; num_slots = dummy (writes drop)
+            prompt_ids,  # [A, Q] int32 left-padded
+            prompt_mask,  # [A, Q] int32
+            table_turns,  # [A] int32 block-table rotation per slot
+            need,  # [n_scan_chunks] bool — host plan ∩ pump window
+            shared_map=None,  # [A, nb] int32 (sharing engines only)
+            publish_map=None,
+        ) -> EngineState:
+            cache_slice = slice_group_cache(
+                state, slot_ids, table_turns, shared_map, publish_map
+            )
+            positions = jnp.clip(
+                jnp.cumsum(prompt_mask, axis=-1) - 1, 0, None
+            )
+
+            def body(cache, c):
+                def run(cch):
+                    ids_c = jax.lax.dynamic_slice_in_dim(
+                        prompt_ids, c * W, W, axis=1
+                    )
+                    pos_c = jax.lax.dynamic_slice_in_dim(
+                        positions, c * W, W, axis=1
+                    )
+                    out = apply_fn(
+                        params,
+                        ids_c,
+                        attention_mask=prompt_mask,  # Q-wide view
+                        position_ids=pos_c,
+                        cache=cch,
+                        cache_index=c * W,
+                        **chunk_kwargs,
+                    )
+                    return out["cache"]
+
+                return jax.lax.cond(need[c], run, lambda cch: cch, cache), None
+
+            cache_slice, _ = jax.lax.scan(
+                body, cache_slice, jnp.arange(n_scan_chunks)
+            )
+            return dataclasses.replace(
+                state,
+                cache=pin_cache(
+                    merge_group_cache(state, slot_ids, cache_slice)
+                ),
+            )
+
+        def prefill_finish(
+            params,
+            state: EngineState,
+            slot_ids,
+            prompt_ids,
+            prompt_mask,
+            row_index,
+            table_turns,
+            phase_key,
+            shared_map=None,
+            publish_map=None,
+        ) -> EngineState:
+            A = prompt_ids.shape[0]
+            row_keys = make_row_keys(phase_key, row_index)
+            n_real = jnp.sum(prompt_mask, axis=-1).astype(jnp.int32)
+            cache_slice = slice_group_cache(
+                state, slot_ids, table_turns, shared_map, publish_map
+            )
+            positions = jnp.clip(
+                jnp.cumsum(prompt_mask, axis=-1) - 1, 0, None
+            )
+            off = Q - W  # static: the final chunk's column offset
+            out = apply_fn(
+                params,
+                prompt_ids[:, off:],
+                attention_mask=prompt_mask,  # Q-wide view
+                position_ids=positions[:, off:],
+                cache=cache_slice,
+                cache_index=off,
+                **prefill_kwargs,
+            )
+            logits_last = out["logits"][:, -1].astype(jnp.float32)
+            if with_values:
+                value_last = out["values"][:, -1].astype(jnp.float32)
+            else:
+                value_last = jnp.zeros((A,), jnp.float32)
+            if cfg.max_length > 0:
+                finished0 = n_real >= cfg.max_length
+            else:
+                finished0 = jnp.zeros((A,), bool)
+            new_cache = merge_group_cache(state, slot_ids, out["cache"])
+
+            def put(field, rows):
+                return field.at[slot_ids].set(
+                    rows.astype(field.dtype), mode="drop"
+                )
+
+            return dataclasses.replace(
+                state,
+                cache=pin_cache(new_cache),
+                row_keys=put(state.row_keys, row_keys),
+                t=put(state.t, jnp.zeros((A,), jnp.int32)),
+                n_real=put(state.n_real, n_real),
+                logits_last=put(state.logits_last, logits_last),
+                value_last=put(state.value_last, value_last),
+                active=put(state.active, jnp.ones((A,), bool)),
+                finished=put(state.finished, finished0),
+                out_tokens=put(
+                    state.out_tokens,
+                    jnp.full((A, R), cfg.pad_token_id, jnp.int32),
+                ),
+                out_mask=put(state.out_mask, jnp.zeros((A, R), jnp.int32)),
+                out_logprobs=put(
+                    state.out_logprobs, jnp.zeros((A, R), jnp.float32)
+                ),
+                out_values=put(
+                    state.out_values, jnp.zeros((A, R), jnp.float32)
+                ),
+                query_ids=put(state.query_ids, prompt_ids),
+                query_mask=put(state.query_mask, prompt_mask),
+                row_index=put(state.row_index, row_index),
+            )
+
         if self.mesh is not None and self._param_shardings is not None:
             from trlx_tpu.parallel.mesh import batch_sharding, replicated
 
@@ -722,6 +970,48 @@ class ContinuousBatchingEngine:
             self.refill_jit = jax.jit(refill, donate_argnums=(0,))
             self.release_jit = jax.jit(release, donate_argnums=(0,))
 
+        self.prefill_chunks_jit = None
+        self.prefill_finish_jit = None
+        if self.prefill_chunk > 0:
+            if self.mesh is not None and self._param_shardings is not None:
+                from trlx_tpu.parallel.mesh import batch_sharding, replicated
+
+                state_sh = self.state_sharding()
+                batch_sh = batch_sharding(self.mesh)
+                rep = replicated(self.mesh)
+                chunks_in = [
+                    self._param_shardings, state_sh, rep, batch_sh,
+                    batch_sh, rep, rep,
+                ]
+                finish_in = [
+                    self._param_shardings, state_sh, rep, batch_sh,
+                    batch_sh, rep, rep, rep,
+                ]
+                if sharing:
+                    chunks_in += [rep, rep]
+                    finish_in += [rep, rep]
+                if n_scan_chunks > 0:
+                    self.prefill_chunks_jit = jax.jit(
+                        prefill_chunks,
+                        in_shardings=tuple(chunks_in),
+                        out_shardings=state_sh,
+                        donate_argnums=(1,),
+                    )
+                self.prefill_finish_jit = jax.jit(
+                    prefill_finish,
+                    in_shardings=tuple(finish_in),
+                    out_shardings=state_sh,
+                    donate_argnums=(1,),
+                )
+            else:
+                if n_scan_chunks > 0:
+                    self.prefill_chunks_jit = jax.jit(
+                        prefill_chunks, donate_argnums=(1,)
+                    )
+                self.prefill_finish_jit = jax.jit(
+                    prefill_finish, donate_argnums=(1,)
+                )
+
     # --------------------------- host loop ----------------------------- #
 
     def start_phase(self, params, phase_key, row_start: int = 0) -> None:
@@ -737,6 +1027,7 @@ class ContinuousBatchingEngine:
         self._free = list(range(self.num_slots))
         self._busy_rows = {}
         self._done_slots = []
+        self._inflight_admission = None
         self._recycle_counts[:] = 0
         self._next_row = row_start
         self.param_version = 0
@@ -937,119 +1228,360 @@ class ContinuousBatchingEngine:
             record["step_epochs"] = [e for _, e in window]
         return record
 
-    def _admit(self) -> None:
-        """Refill free slots from the queue, one padded prefill call per
-        ``admit_width`` group."""
+    def _plan_chunk_need(self, prompt_mask, shared_map, publish_map):
+        """[n_prefill_chunks] bool: which prompt-column chunks ANY row of
+        the admit group actually needs computed. Column-granular: a
+        column is needed when it is a real (non-pad) column not served
+        read-only from the shared-prefix pool, or when its block is
+        being PUBLISHED into the pool (the donor must compute what it
+        publishes, pad columns included — readers gather the donor's
+        bits). Leading all-pad chunks of a left-padded group and
+        fully-pool-covered shared chunks come out un-needed. The same
+        vector gates the jitted scan's ``lax.cond`` — host and device
+        share one plan, so the skip accounting is transfer-free."""
+        Q, W = self.Q, self.prefill_chunk
+        mask = np.asarray(prompt_mask)
+        first_real = Q - mask.sum(axis=1)
+        cols = np.arange(Q)
+        needed = cols[None, :] >= first_real[:, None]
+        if shared_map is not None:
+            bs = self.block_size
+            col_blk = np.minimum(cols // bs, self.n_blocks - 1)
+            covered = (shared_map[:, col_blk] >= 0) & (
+                publish_map[:, col_blk] < 0
+            )
+            publishes = publish_map[:, col_blk] >= 0
+            needed = (needed & ~covered) | publishes
+        return needed.reshape(mask.shape[0], Q // W, W).any(2).any(0)
+
+    def _begin_admission(self) -> None:
+        """Reserve slots for the next ``admit_width`` group and stage its
+        host arrays; the device dispatch happens in
+        :meth:`_advance_admission` (one monolithic prefill call, or
+        need-gated chunk windows plus the finish program)."""
         sharing = self.prefix_pool_blocks > 0
         nb_prompt = self.Q // self.block_size  # shareable prompt blocks
-        while self._free and self._queue:
-            with telemetry.span("collect/admit", force=True):
-                A = self.admit_width
-                take = min(len(self._free), len(self._queue), A)
-                slots = [self._free.pop(0) for _ in range(take)]
-                entries = [self._queue.pop(0) for _ in range(take)]
-                prompt_ids = np.zeros((A, self.Q), np.int32)
-                prompt_mask = np.zeros((A, self.Q), np.int32)
-                slot_ids = np.full((A,), self.num_slots, np.int32)  # dummies
-                row_index = np.zeros((A,), np.int32)
-                turns = np.zeros((A,), np.int32)
-                shared_map = np.full((A, self.n_blocks), -1, np.int32)
-                publish_map = np.full((A, self.n_blocks), -1, np.int32)
-                released_slots = []
-                for i, (
-                    slot,
-                    (ids, mask, row, sh_row, pub_row, release),
-                ) in enumerate(zip(slots, entries)):
-                    prompt_ids[i] = ids
-                    prompt_mask[i] = mask
-                    slot_ids[i] = slot
-                    row_index[i] = row
-                    turns[i] = self._recycle_counts[slot]
-                    self._busy_rows[slot] = row
-                    # behavior-version tag: the params this row's whole
-                    # prefill (and its first decode steps) run under
-                    self._slot_versions[slot] = self.param_version
-                    if release:
-                        released_slots.append(slot)
-                    if sh_row is not None:
-                        shared_map[i, : len(sh_row)] = sh_row
-                    if pub_row is not None:
-                        publish_map[i, : len(pub_row)] = pub_row
-                    if sharing and not release:
-                        hits = int(
-                            np.sum(
-                                (shared_map[i] >= 0) & (publish_map[i] < 0)
-                            )
+        with telemetry.span("collect/admit", force=True):
+            A = self.admit_width
+            take = min(len(self._free), len(self._queue), A)
+            slots = [self._free.pop(0) for _ in range(take)]
+            entries = [self._queue.pop(0) for _ in range(take)]
+            prompt_ids = np.zeros((A, self.Q), np.int32)
+            prompt_mask = np.zeros((A, self.Q), np.int32)
+            slot_ids = np.full((A,), self.num_slots, np.int32)  # dummies
+            row_index = np.zeros((A,), np.int32)
+            turns = np.zeros((A,), np.int32)
+            shared_map = np.full((A, self.n_blocks), -1, np.int32)
+            publish_map = np.full((A, self.n_blocks), -1, np.int32)
+            released_slots = []
+            for i, (
+                slot,
+                (ids, mask, row, sh_row, pub_row, release),
+            ) in enumerate(zip(slots, entries)):
+                prompt_ids[i] = ids
+                prompt_mask[i] = mask
+                slot_ids[i] = slot
+                row_index[i] = row
+                turns[i] = self._recycle_counts[slot]
+                self._busy_rows[slot] = row
+                # behavior-version tag: the params this row's whole
+                # prefill (and its first decode steps) run under
+                self._slot_versions[slot] = self.param_version
+                if release:
+                    released_slots.append(slot)
+                if sh_row is not None:
+                    shared_map[i, : len(sh_row)] = sh_row
+                if pub_row is not None:
+                    publish_map[i, : len(pub_row)] = pub_row
+                if sharing and not release:
+                    hits = int(
+                        np.sum(
+                            (shared_map[i] >= 0) & (publish_map[i] < 0)
                         )
-                        self.stats.prefix_lookup_blocks += nb_prompt
-                        self.stats.prefix_hit_blocks += hits
-                        self.stats.prefix_published_blocks += int(
-                            np.sum(publish_map[i] >= 0)
-                        )
-                args = (prompt_ids, prompt_mask)
-                if self.mesh is not None:
-                    from trlx_tpu.parallel.mesh import batch_sharding
+                    )
+                    self.stats.prefix_lookup_blocks += nb_prompt
+                    self.stats.prefix_hit_blocks += hits
+                    self.stats.prefix_published_blocks += int(
+                        np.sum(publish_map[i] >= 0)
+                    )
+            args = (prompt_ids, prompt_mask)
+            if self.mesh is not None:
+                from trlx_tpu.parallel.mesh import batch_sharding
 
-                    args = jax.device_put(args, batch_sharding(self.mesh))
-            t_admit = telemetry.monotonic()
+                args = jax.device_put(args, batch_sharding(self.mesh))
+        self._inflight_admission = {
+            "take": take,
+            "entries": entries,
+            "slot_ids": slot_ids,
+            "row_index": row_index,
+            "turns": turns,
+            "ids": args[0],
+            "mask": args[1],
+            "shared_map": shared_map if sharing else None,
+            "publish_map": publish_map if sharing else None,
+            "released_slots": released_slots,
+            "need": (
+                self._plan_chunk_need(
+                    prompt_mask,
+                    shared_map if sharing else None,
+                    publish_map if sharing else None,
+                )
+                if self.prefill_chunk > 0
+                else None
+            ),
+            "next_chunk": 0,
+            "chunk_walls": [],
+            "t_admit": telemetry.monotonic(),
+        }
+
+    def _advance_admission(
+        self, budget: Optional[int]
+    ) -> Tuple[bool, int]:
+        """Dispatch the in-flight admission's next slice of prefill work:
+        the whole group (monolithic, or ``budget=None``), else at most
+        ``budget`` chunk forwards (the serving pump's Sarathi-style
+        stall-free bound — skipped chunks are free and never count).
+        Returns ``(admission complete, chunk forwards dispatched)``."""
+        adm = self._inflight_admission
+        sharing = self.prefix_pool_blocks > 0
+        map_args = []
+        if sharing:
+            map_args = [
+                jnp.asarray(adm["shared_map"]),
+                jnp.asarray(adm["publish_map"]),
+            ]
+        if self.prefill_chunk == 0:
             with telemetry.span(
-                "collect/prefill", force=True, admitted=take
+                "collect/prefill", force=True, admitted=adm["take"]
             ):
-                prefill_args = [
+                self._state = self.prefill_jit(
                     self._params,
                     self._state,
-                    jnp.asarray(slot_ids),
-                    args[0],
-                    args[1],
-                    jnp.asarray(row_index),
-                    jnp.asarray(turns),
+                    jnp.asarray(adm["slot_ids"]),
+                    adm["ids"],
+                    adm["mask"],
+                    jnp.asarray(adm["row_index"]),
+                    jnp.asarray(adm["turns"]),
                     self._phase_key,
-                ]
-                if sharing:
-                    prefill_args += [
-                        jnp.asarray(shared_map),
-                        jnp.asarray(publish_map),
-                    ]
-                self._state = self.prefill_jit(*prefill_args)
-            if released_slots:
-                # padding placeholders: force-finish now so they cost
-                # one decode step, not a full token budget. Fixed
-                # admit_width call shape (num_slots = OOB dummy, the
-                # scatter drops) — one compiled program regardless of
-                # how many placeholders an admission carried.
-                rel = np.full((A,), self.num_slots, np.int32)
-                rel[: len(released_slots)] = released_slots
-                self._state = self.release_jit(
-                    self._state, jnp.asarray(rel)
+                    *map_args,
                 )
-                self.stats.released += len(released_slots)
-            # prefill computes the group's FIRST tokens, so its dispatch
-            # end is the host-side time-to-first-token mark
-            t_first = telemetry.monotonic()
-            for entry in entries:
-                marks = self._req_times.get(entry[2])
-                if marks is not None:
-                    marks["admitted"] = t_admit
-                    marks["first_token"] = t_first
-                    if self.trace_requests:
-                        # decode-cadence window start: this row's live
-                        # steps begin at the current step-log position
-                        # (absolute index — survives log pruning)
-                        marks["admit_step"] = (
-                            self._step_base + len(self._step_log)
-                        )
-            self.stats.prefills += 1
-            self.stats.admitted += take
-            if sharing:
-                registry = telemetry.get_metrics()
-                registry.gauge("engine/prefix_hit_rate").set(
-                    self.stats.prefix_hit_rate
+            self._finalize_admission()
+            return True, 1
+        n_scan = self.n_prefill_chunks - 1
+        need = adm["need"]
+        spent = 0
+        if adm["next_chunk"] < n_scan:
+            lo = adm["next_chunk"]
+            idx = [c for c in range(lo, n_scan) if need[c]]
+            if budget is not None and budget > 0 and len(idx) > budget:
+                run = idx[:budget]
+                hi = run[-1] + 1
+            else:
+                run = idx
+                hi = n_scan
+            if run:
+                window = np.zeros((n_scan,), bool)
+                window[run] = True
+                with telemetry.span(
+                    "collect/prefill", force=True,
+                    admitted=adm["take"], chunks=len(run),
+                ):
+                    self._state = self.prefill_chunks_jit(
+                        self._params,
+                        self._state,
+                        jnp.asarray(adm["slot_ids"]),
+                        adm["ids"],
+                        adm["mask"],
+                        jnp.asarray(adm["turns"]),
+                        jnp.asarray(window),
+                        *map_args,
+                    )
+                self.stats.prefill_chunks += len(run)
+                spent = len(run)
+                adm["chunk_walls"].append(
+                    (run[0] * self.prefill_chunk, telemetry.monotonic())
                 )
-                registry.gauge("engine/prefix_blocks_saved").set(
-                    self.stats.prefix_blocks_saved
-                )
-            if self._admit_listener is not None:
-                self._admit_listener([e[2] for e in entries])
+            adm["next_chunk"] = hi
+            if hi < n_scan or (budget is not None and spent >= budget):
+                return False, spent
+        # the finish chunk always runs: every left-padded row's last
+        # real column lives there, and it produces logits_last
+        with telemetry.span(
+            "collect/prefill", force=True,
+            admitted=adm["take"], chunks=1, finish=True,
+        ):
+            self._state = self.prefill_finish_jit(
+                self._params,
+                self._state,
+                jnp.asarray(adm["slot_ids"]),
+                adm["ids"],
+                adm["mask"],
+                jnp.asarray(adm["row_index"]),
+                jnp.asarray(adm["turns"]),
+                self._phase_key,
+                *map_args,
+            )
+        self.stats.prefill_chunks += 1
+        adm["chunk_walls"].append(
+            ((self.n_prefill_chunks - 1) * self.prefill_chunk,
+             telemetry.monotonic())
+        )
+        skipped = int(n_scan - np.count_nonzero(need[:n_scan]))
+        self.stats.prefill_cols_skipped += skipped * self.prefill_chunk
+        if skipped:
+            # lazy one-time abstract trace — only ever paid once a group
+            # actually skipped something (a no-skip serving workload
+            # must not stall its first admission tracing the program
+            # just to multiply the per-chunk cost by zero)
+            self.stats.prefill_flops_saved += (
+                skipped * self._chunk_flop_cost()
+            )
+        self._finalize_admission()
+        return True, spent + 1
+
+    def _finalize_admission(self) -> None:
+        """Admission bookkeeping after the group's LAST prefill dispatch:
+        placeholder release, latency marks, stats/gauges, and the admit
+        listener (published prefix blocks become readable only now —
+        every chunk that writes them has been dispatched)."""
+        adm = self._inflight_admission
+        self._inflight_admission = None
+        sharing = self.prefix_pool_blocks > 0
+        A = self.admit_width
+        released_slots = adm["released_slots"]
+        if released_slots:
+            # padding placeholders: force-finish now so they cost
+            # one decode step, not a full token budget. Fixed
+            # admit_width call shape (num_slots = OOB dummy, the
+            # scatter drops) — one compiled program regardless of
+            # how many placeholders an admission carried.
+            rel = np.full((A,), self.num_slots, np.int32)
+            rel[: len(released_slots)] = released_slots
+            self._state = self.release_jit(self._state, jnp.asarray(rel))
+            self.stats.released += len(released_slots)
+        # the last prefill dispatch computes the group's FIRST tokens,
+        # so its dispatch end is the host-side time-to-first-token mark
+        t_first = telemetry.monotonic()
+        chunk_offsets = [
+            {
+                "col": int(col),
+                "ms": round((t - adm["t_admit"]) * 1000.0, 3),
+            }
+            for col, t in adm["chunk_walls"]
+        ]
+        for entry in adm["entries"]:
+            marks = self._req_times.get(entry[2])
+            if marks is not None:
+                marks["admitted"] = adm["t_admit"]
+                marks["first_token"] = t_first
+                if chunk_offsets:
+                    # per-chunk-window dispatch offsets (column, ms after
+                    # admission): the serve/prefill trace span carries
+                    # these so --trace-report attributes chunked
+                    # admissions (docs/observability.md)
+                    marks["prefill_chunk_offsets"] = chunk_offsets
+                if self.trace_requests:
+                    # decode-cadence window start: this row's live
+                    # steps begin at the current step-log position
+                    # (absolute index — survives log pruning)
+                    marks["admit_step"] = (
+                        self._step_base + len(self._step_log)
+                    )
+        self.stats.prefills += 1
+        self.stats.admitted += adm["take"]
+        registry = telemetry.get_metrics()
+        if sharing:
+            registry.gauge("engine/prefix_hit_rate").set(
+                self.stats.prefix_hit_rate
+            )
+            registry.gauge("engine/prefix_blocks_saved").set(
+                self.stats.prefix_blocks_saved
+            )
+        if self.prefill_chunk > 0:
+            registry.gauge("engine/prefill_chunks").set(
+                float(self.stats.prefill_chunks)
+            )
+            registry.gauge("engine/prefill_cols_skipped").set(
+                float(self.stats.prefill_cols_skipped)
+            )
+            registry.gauge("engine/prefill_flops_saved").set(
+                float(self.stats.prefill_flops_saved)
+            )
+        if self._admit_listener is not None:
+            self._admit_listener([e[2] for e in adm["entries"]])
+
+    def _chunk_flop_cost(self) -> float:
+        """Exact dot-FLOPs of ONE prefill chunk forward, read off the
+        traced chunked program with engine-7's counter
+        (``analysis/resource_audit.py::count_flops``: the scan body at
+        its cond's run branch, times one). Traced lazily once per engine
+        — abstract trace only, no compilation — so
+        ``engine/prefill_flops_saved`` is a real FLOP number, not a
+        heuristic; 0.0 when tracing is unavailable."""
+        if self._chunk_flops is not None:
+            return self._chunk_flops
+        self._chunk_flops = 0.0
+        n_scan = self.n_prefill_chunks - 1
+        if (
+            self.prefill_chunks_jit is None
+            or n_scan < 1
+            or self._params is None
+        ):
+            return self._chunk_flops
+        try:
+            from trlx_tpu.analysis.resource_audit import count_flops
+
+            sds = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._params,
+            )
+            A, Q = self.admit_width, self.Q
+            i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+            args = [
+                sds,
+                jax.eval_shape(self._make_state),
+                i32(A),
+                i32(A, Q),
+                i32(A, Q),
+                i32(A),
+                jax.ShapeDtypeStruct((n_scan,), jnp.bool_),
+            ]
+            if self.prefix_pool_blocks > 0:
+                args += [i32(A, self.n_blocks), i32(A, self.n_blocks)]
+            closed = jax.make_jaxpr(self.prefill_chunks_jit)(*args)
+            self._chunk_flops = count_flops(closed.jaxpr) / n_scan
+        except Exception:  # pragma: no cover - accounting must never kill
+            self._chunk_flops = 0.0
+        return self._chunk_flops
+
+    def _admit(self) -> None:
+        """Complete every possible admission inline (the drive() /
+        unbudgeted-pump path): one padded prefill per ``admit_width``
+        group — monolithic, or the group's full chunk plan + finish."""
+        if self._inflight_admission is not None:
+            self._advance_admission(None)
+        while self._free and self._queue:
+            self._begin_admission()
+            self._advance_admission(None)
+
+    def _pump_admission(self, budget: int) -> None:
+        """Advance admission by at most ``budget`` chunk forwards this
+        pump iteration (``rollout.prefill_chunks_per_pump``): a large
+        admission burst interleaves with decode steps instead of
+        stalling them. A staged weight push applies only BETWEEN groups
+        — a group's whole prefill runs under one params version (the
+        version-tag contract push_weights documents)."""
+        remaining = budget
+        while remaining > 0:
+            if self._inflight_admission is None:
+                self._apply_pending_push()
+                if not (self._free and self._queue):
+                    return
+                self._begin_admission()
+            done, spent = self._advance_admission(remaining)
+            remaining -= max(1, spent)
+            if not done:
+                return
 
     def _harvest_ready(self) -> Iterator[Dict[str, Any]]:
         """Yield fixed-width harvest groups while enough slots are done."""
@@ -1134,8 +1666,11 @@ class ContinuousBatchingEngine:
             # safe point for a staged weight push (async actor–learner):
             # harvest bookkeeping is settled and the queued admit group
             # is about to prefill under the refreshed params — a push
-            # can never drop or reorder it
-            self._apply_pending_push()
+            # can never drop or reorder it. Never swap params while an
+            # admission group is mid-prefill (chunked, pump-interleaved):
+            # its chunks must all run under one version.
+            if self._inflight_admission is None:
+                self._apply_pending_push()
             self._admit()
             if not self._busy_rows:
                 # nothing decoding and nothing harvestable: the queue
@@ -1248,10 +1783,21 @@ class ContinuousBatchingEngine:
         serving tier interleaves QoS admission decisions between
         iterations instead of committing a whole phase's prompt set up
         front. Raises nothing on an idle pool (an empty pump is how the
-        serving loop discovers it is drained)."""
+        serving loop discovers it is drained).
+
+        With ``prefill_chunk > 0`` and ``prefill_chunks_per_pump > 0``,
+        one pump dispatches at most that many prefill-chunk forwards
+        before advancing decode — a large admission burst spreads its
+        prefill across pump iterations (Sarathi-style stall-free
+        admission) instead of stalling every running slot for the whole
+        burst."""
         groups = list(self._harvest_ready())
-        self._apply_pending_push()
-        self._admit()
+        if self.prefill_chunks_per_pump > 0:
+            self._pump_admission(self.prefill_chunks_per_pump)
+        else:
+            if self._inflight_admission is None:
+                self._apply_pending_push()
+            self._admit()
         if self._busy_rows:
             self._decode_once()
         return groups

@@ -93,6 +93,37 @@ def choose_block_size(capacity: int, requested: int) -> int:
     return bs
 
 
+def choose_prefill_chunk(
+    query_length: int, requested: int, block_size: int
+) -> int:
+    """Effective chunked-prefill width for ``rollout.prefill_chunk``.
+
+    The chunk must tile the prompt columns exactly (divide Q — a ragged
+    tail chunk would need its own program shape) and should align to the
+    paged-KV block size so a pool-covered shared block is never split
+    across a run/skip boundary. Returns the largest divisor of ``Q`` that
+    is ``<= requested`` and a ``block_size`` multiple; when no aligned
+    divisor exists (block size does not divide Q — e.g. the block was
+    auto-shrunk against a capacity Q+R that Q does not share factors
+    with), falls back to the largest plain divisor — chunk-skip decisions
+    are column-granular, so correctness never depends on alignment, only
+    the shared-skip efficiency does. ``requested <= 0`` disables chunking
+    (the monolithic prefill).
+    """
+    if requested <= 0:
+        return 0
+    hi = min(int(requested), int(query_length))
+    fallback = 1
+    for w in range(hi, 0, -1):
+        if query_length % w:
+            continue
+        if w % block_size == 0:
+            return w
+        if fallback == 1:
+            fallback = w
+    return fallback
+
+
 def identity_block_tables(n_slots: int, n_blocks: int) -> jax.Array:
     """[B, n_blocks] int32 identity mapping (fresh slots)."""
     return jnp.broadcast_to(
@@ -253,13 +284,18 @@ def _shared_gather(
     shared_tables: jax.Array,  # [B, n_blocks] int32, -1 = private
     pool: jax.Array,  # [pool_positions, H, ...] shared values
     capacity: int,
+    view_len: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per logical position, the shared-pool value (garbage where the
     block is private) and the [B, capacity] bool mask of shared
-    positions — the read-side overlay inputs."""
+    positions — the read-side overlay inputs. ``view_len > 0`` narrows
+    the overlay to the leading ``view_len`` logical positions (the
+    chunked prefill's prompt-region view — shared prefix blocks all live
+    there, so the narrowed overlay gathers strictly less)."""
     n_blocks = shared_tables.shape[-1]
     bs = capacity // n_blocks
-    cols = jnp.arange(capacity, dtype=jnp.int32)
+    width = view_len if 0 < view_len < capacity else capacity
+    cols = jnp.arange(width, dtype=jnp.int32)
     sh_blk = jnp.take(shared_tables, cols // bs, axis=1)  # [B, capacity]
     sh_pos = sh_blk * bs + cols[None, :] % bs
     safe = jnp.clip(sh_pos, 0, pool.shape[0] - 1)
@@ -272,6 +308,7 @@ def paged_write_read(
     v: jax.Array,
     cache_index,  # scalar or [B] logical base position of the new rows
     dtype,
+    view_len: int = 0,
 ) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
     """Paged counterpart of the linear ``write_cache`` arm: write the new
     K/V rows through the block table, then return the **logical view** of
@@ -281,6 +318,12 @@ def paged_write_read(
     different depths) or scalar (broadcast). int8 pools quantize on write
     and dequantize the gathered view — same bits as the linear int8 path
     per logical position.
+
+    ``view_len > 0`` narrows the returned logical view (and the shared
+    overlay) to the leading ``view_len`` positions — chunk-granular
+    reads for the chunked prefill, whose prompt-chunk queries never
+    attend the decode region. Writes are NEVER narrowed: positions
+    resolve through the table at full capacity regardless.
     """
     B, T = k.shape[0], k.shape[1]
     capacity = cache_kv["k"].shape[1]
@@ -289,6 +332,8 @@ def paged_write_read(
     positions = base[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     phys = physical_positions(tables, positions, capacity)
     view = logical_view_index(tables, capacity)
+    if 0 < view_len < capacity:
+        view = view[:, :view_len]
 
     sharing = "shared_tables" in cache_kv
     pub_pos = None
@@ -321,12 +366,14 @@ def paged_write_read(
         if not sharing:
             return full
         pool_vals, mask = _shared_gather(
-            cache_kv["shared_tables"], new_kv[pool_key], capacity
+            cache_kv["shared_tables"], new_kv[pool_key], capacity,
+            view_len=view_len,
         )
         vals = pool_vals.astype(dtype)
         if scale_key is not None:
             scales, _ = _shared_gather(
-                cache_kv["shared_tables"], new_kv[scale_key], capacity
+                cache_kv["shared_tables"], new_kv[scale_key], capacity,
+                view_len=view_len,
             )
             vals = vals * scales.astype(dtype)
         return jnp.where(mask[..., None, None], vals, full)

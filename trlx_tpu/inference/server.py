@@ -229,7 +229,8 @@ class InferenceServer:
         )
 
         def apply_fn(p, input_ids, attention_mask=None, position_ids=None,
-                     cache=None, cache_index=None, last_only=False):
+                     cache=None, cache_index=None, last_only=False,
+                     skip_heads=False):
             return self.model.apply(
                 {"params": p},
                 input_ids,
@@ -238,6 +239,7 @@ class InferenceServer:
                 cache=cache,
                 cache_index=cache_index,
                 last_only=last_only,
+                skip_heads=skip_heads,
             )
 
         import functools
@@ -259,6 +261,8 @@ class InferenceServer:
             with_values=True,
             prefix_pool_blocks=self.serving_config.prefix_cache_blocks,
             stream_taps=True,
+            prefill_chunk=rollout.prefill_chunk,
+            prefill_chunks_per_pump=rollout.prefill_chunks_per_pump,
         )
         # fold_in consumes rng without a dangling split chain (the
         # key-lineage engine's key-discard rule)

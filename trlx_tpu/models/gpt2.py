@@ -130,9 +130,14 @@ class Attention(nn.Module):
         new_kv = None
         if cache_kv is not None:
             # Write this step's keys/values into the capacity buffer at
-            # cache_index, then attend over the whole buffer (invalid
-            # positions are masked by `bias`).
-            k, v, new_kv = write_cache(cache_kv, k, v, cache_index, dtype)
+            # cache_index, then attend over the buffer VIEW the bias was
+            # built for (invalid positions are masked by `bias`; a bias
+            # narrower than capacity — the chunked prefill's prompt-only
+            # mask — narrows the attention view to match).
+            view_len = bias.shape[-1] if bias is not None else None
+            k, v, new_kv = write_cache(
+                cache_kv, k, v, cache_index, dtype, view_len=view_len
+            )
 
         out = dot_product_attention(q, k, v, bias, causal=causal)
         out = out.reshape(B, T, cfg.n_embd)
@@ -265,7 +270,7 @@ def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return q.astype(jnp.int8), scale.astype(jnp.bfloat16)
 
 
-def write_cache(cache_kv, k, v, cache_index, dtype):
+def write_cache(cache_kv, k, v, cache_index, dtype, view_len=None):
     """Write this step's K/V into the capacity buffers at ``cache_index``;
     returns ``(k, v, new_kv)`` — the full buffers to attend over and the
     updated cache dict. Transparent over the three storage layouts
@@ -281,12 +286,24 @@ def write_cache(cache_kv, k, v, cache_index, dtype):
       positions through per-slot block tables (``cache_index`` may be a
       per-slot [B] vector), reads return the logical view; composes
       with the int8 layout.
+
+    ``view_len`` (static) narrows the RETURNED attention view to the
+    leading ``view_len`` logical positions — the families derive it from
+    their attention bias width (``ops/attention.py::causal_dispatch``:
+    mask width == view width), so the chunked prefill's prompt-chunk
+    forwards never read (or pay attention FLOPs over) the decode region.
+    ``None``/full-capacity is byte-identical to the unnarrowed program;
+    writes always resolve at full capacity.
     """
     if "block_tables" in cache_kv:
         from trlx_tpu.inference.kv_cache import paged_write_read
 
-        return paged_write_read(cache_kv, k, v, cache_index, dtype)
+        return paged_write_read(
+            cache_kv, k, v, cache_index, dtype, view_len=view_len or 0
+        )
     at = (0, cache_index, 0, 0)
+    capacity = cache_kv["k"].shape[1]
+    narrow = view_len is not None and 0 < view_len < capacity
     if "k_scale" in cache_kv:
         k_q, k_s = quantize_kv(k)
         v_q, v_s = quantize_kv(v)
@@ -300,12 +317,19 @@ def write_cache(cache_kv, k, v, cache_index, dtype):
                 cache_kv["v_scale"], v_s, at
             ),
         }
-        k = new_kv["k"].astype(dtype) * new_kv["k_scale"].astype(dtype)
-        v = new_kv["v"].astype(dtype) * new_kv["v_scale"].astype(dtype)
+        k_read = new_kv["k"][:, :view_len] if narrow else new_kv["k"]
+        v_read = new_kv["v"][:, :view_len] if narrow else new_kv["v"]
+        k_s_read = new_kv["k_scale"][:, :view_len] if narrow else new_kv["k_scale"]
+        v_s_read = new_kv["v_scale"][:, :view_len] if narrow else new_kv["v_scale"]
+        k = k_read.astype(dtype) * k_s_read.astype(dtype)
+        v = v_read.astype(dtype) * v_s_read.astype(dtype)
         return k, v, new_kv
     k = jax.lax.dynamic_update_slice(cache_kv["k"], k, at)
     v = jax.lax.dynamic_update_slice(cache_kv["v"], v, at)
-    return k, v, {"k": k, "v": v}
+    new_kv = {"k": k, "v": v}
+    if narrow:
+        return k[:, :view_len], v[:, :view_len], new_kv
+    return k, v, new_kv
 
 
 # Measured crossover for the int8 KV cache (LONGCTX.json): int8 wins 1.10x

@@ -121,10 +121,24 @@ def local_causal_bias(
     """[1, 1, Q, K] band bias: j <= i and i - j < window (sliding window).
 
     Matches HF GPT-Neo local attention: each query sees at most ``window``
-    most recent positions including itself.
+    most recent positions including itself. A [B]-vector ``offset`` (rows
+    decoding at different cache depths — the continuous-batching
+    engine's per-row ``cache_index``) yields a [B, 1, Q, K] bias, the
+    same contract as ``ops/attention.py::causal_bias`` — without this
+    branch the slot-admission engine could not serve local-attention
+    GPT-Neo configs at all.
     """
-    q_pos = jnp.arange(q_len)[:, None] + offset
+    off = jnp.asarray(offset)
     k_pos = jnp.arange(kv_len)[None, :]
+    if off.ndim:
+        q_pos = (
+            jnp.arange(q_len)[None, :, None]
+            + off.astype(jnp.int32)[:, None, None]
+        )  # [B, Q, 1]
+        kb = k_pos[None, :, :]
+        visible = (kb <= q_pos) & (q_pos - kb < window)
+        return jnp.where(visible, 0.0, NEG_INF).astype(dtype)[:, None, :, :]
+    q_pos = jnp.arange(q_len)[:, None] + off
     visible = (k_pos <= q_pos) & (q_pos - k_pos < window)
     return jnp.where(visible, 0.0, NEG_INF).astype(dtype)[None, None, :, :]
 
@@ -156,7 +170,12 @@ class GPTNeoAttention(nn.Module):
         if cache_kv is not None:
             from trlx_tpu.models.gpt2 import write_cache
 
-            k, v, new_kv = write_cache(cache_kv, k, v, cache_index, dtype)
+            # bias width == attention view width (a prompt-only mask —
+            # the chunked prefill — narrows the cache view to match)
+            view_len = bias.shape[-1] if bias is not None else None
+            k, v, new_kv = write_cache(
+                cache_kv, k, v, cache_index, dtype, view_len=view_len
+            )
 
         # GPT-Neo does not scale attention logits; cancel the shared core's
         # 1/sqrt(d) (HF computes q @ k^T directly in float32).
@@ -263,7 +282,16 @@ class GPTNeoModel(nn.Module):
         if cache is None:
             kv_len, offset = T, 0
         else:
-            kv_len, offset = cache[0]["k"].shape[1], cache_index
+            # mask width == attention view width (the chunked prefill's
+            # prompt-only mask narrows the cache view; full-capacity
+            # callers are unchanged) — must agree with causal_dispatch
+            # or the local band bias misaligns with the padding bias
+            kv_len = (
+                attention_mask.shape[-1]
+                if attention_mask is not None
+                else cache[0]["k"].shape[1]
+            )
+            offset = cache_index
         local_bias = combine_biases(
             local_causal_bias(T, kv_len, cfg.window_size, offset=offset), pad
         )

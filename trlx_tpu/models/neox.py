@@ -101,7 +101,12 @@ class NeoXAttention(nn.Module):
         if cache_kv is not None:
             from trlx_tpu.models.gpt2 import write_cache
 
-            k, v, new_kv = write_cache(cache_kv, k, v, cache_index, dtype)
+            # bias width == attention view width (a prompt-only mask —
+            # the chunked prefill — narrows the cache view to match)
+            view_len = bias.shape[-1] if bias is not None else None
+            k, v, new_kv = write_cache(
+                cache_kv, k, v, cache_index, dtype, view_len=view_len
+            )
 
         out = dot_product_attention(q, k, v, bias, causal=causal)
         out = out.reshape(B, T, cfg.hidden_size)

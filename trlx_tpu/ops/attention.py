@@ -78,11 +78,24 @@ def causal_dispatch(
     offset-shifted causal mask must be an explicit bias tensor (the offset
     is traced). Returns ``(bias, causal_flag)`` for
     :func:`dot_product_attention`.
+
+    With a cache, the MASK WIDTH is the attention view width: a caller
+    that passes a validity mask narrower than the cache capacity attends
+    over only the leading ``mask.shape[-1]`` logical positions
+    (``models/gpt2.py::write_cache`` narrows the returned K/V view to the
+    bias width). Every full-capacity caller is unchanged — the narrowed
+    view is the chunked-prefill contract (docs/inference.md): prompt
+    chunks never attend the decode region, whose masked columns carry
+    exactly-zero softmax weight anyway.
     """
     pad = padding_bias(attention_mask) if attention_mask is not None else None
     if cache is None:
         return pad, True
-    kv_len = cache[0]["k"].shape[1]
+    kv_len = (
+        attention_mask.shape[-1]
+        if attention_mask is not None
+        else cache[0]["k"].shape[1]
+    )
     return combine_biases(causal_bias(q_len, kv_len, offset=cache_index), pad), False
 
 

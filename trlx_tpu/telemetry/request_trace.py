@@ -205,7 +205,20 @@ def emit_request_trace(
         child("serve/queue", picked, admitted, leg="post-quota")
     else:
         child("serve/queue", submitted, admitted)
-    child("serve/prefill", admitted, first)
+    prefill_attrs: Dict[str, Any] = {}
+    chunk_offs = marks.get("prefill_chunk_offsets")
+    if chunk_offs:
+        # chunked prefill (rollout.prefill_chunk): one entry per
+        # dispatched chunk window — the prompt-column offset it started
+        # at and its dispatch wall relative to admission, so
+        # --trace-report can attribute a chunked admission's spread
+        # across pump iterations (stall-free admission evidence)
+        prefill_attrs["chunks"] = len(chunk_offs)
+        prefill_attrs["chunk_cols"] = [int(c["col"]) for c in chunk_offs]
+        prefill_attrs["chunk_offsets_ms"] = [
+            float(c["ms"]) for c in chunk_offs
+        ]
+    child("serve/prefill", admitted, first, **prefill_attrs)
     decode_attrs: Dict[str, Any] = {"tokens": int(tokens)}
     offsets: List[float] = []
     if step_times:

@@ -1182,7 +1182,7 @@ class PPOTrainer(BaseRLTrainer):
 
         def apply_fn(params, input_ids, attention_mask=None,
                      position_ids=None, cache=None, cache_index=None,
-                     last_only=False):
+                     last_only=False, skip_heads=False):
             return self.model.apply(
                 {"params": params},
                 input_ids,
@@ -1191,6 +1191,7 @@ class PPOTrainer(BaseRLTrainer):
                 cache=cache,
                 cache_index=cache_index,
                 last_only=last_only,
+                skip_heads=skip_heads,
             )
 
         # actor device subset (async_rl.actor_fraction < 1): the engine
@@ -1264,6 +1265,8 @@ class PPOTrainer(BaseRLTrainer):
             param_shardings=engine_shardings,
             cache_sharding=cache_sharding,
             with_values=True,
+            prefill_chunk=cfg.prefill_chunk,
+            prefill_chunks_per_pump=cfg.prefill_chunks_per_pump,
         )
 
     # ------------------------------------------------------------------ #
