@@ -1,0 +1,631 @@
+"""Golden tests for engines 11-12 (`lockstep.py` + the host-concurrency
+rules in `ast_lint.py`).
+
+PR-1/2/4 pattern: a seeded-violation fixture + a clean case per rule id,
+suppression round-trip for every new rule, the lockstep-fingerprint
+lockfile roundtrip (engine-11 relock preserves the engine-7/8/10
+sections and vice versa), and — the tier-1 canary — one real ilql
+2-host simulation with a planted rank-0-only dispatch: every ordinal
+before the plant must agree across hosts (the clean-loop claim) and the
+divergence must localize to the planted guard's file:line (the
+detection claim). The full 4-trainer × {2,4}-host matrix is nightly
+(``slow``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+# ------------------------------ registry ---------------------------------- #
+
+def test_new_rules_registered_with_engines():
+    from trlx_tpu.analysis.registry import get_rule
+
+    expected = {
+        "lockstep-divergence": ("lockstep", "error"),
+        "dispatch-sequence-drift": ("lockstep", "error"),
+        "rank-gated-dispatch": ("ast", "error"),
+        "nondet-host-order": ("ast", "error"),
+        "host-time-in-dispatch": ("ast", "warning"),
+        "unsynced-host-io": ("ast", "warning"),
+    }
+    for rule_id, (engine, severity) in expected.items():
+        rule = get_rule(rule_id)
+        assert rule.engine == engine, rule_id
+        assert rule.severity == severity, rule_id
+        assert rule.description and rule.rationale, rule_id
+
+
+def test_list_rules_shows_new_ids():
+    out = subprocess.run(
+        [sys.executable, "-m", "trlx_tpu.analysis", "--list-rules"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    for rule_id in (
+        "lockstep-divergence",
+        "dispatch-sequence-drift",
+        "rank-gated-dispatch",
+        "nondet-host-order",
+        "host-time-in-dispatch",
+        "unsynced-host-io",
+    ):
+        assert rule_id in out.stdout, rule_id
+
+
+# ----------------------- engine 12: seeded + clean ------------------------ #
+
+def _lint(src, name="host_loop.py"):
+    from trlx_tpu.analysis.ast_lint import lint_source
+
+    findings, suppressed = lint_source(src, name)
+    return findings, suppressed
+
+
+_RANK_GATED = """
+from trlx_tpu.parallel.distributed import is_main_process
+
+def loop(trainer, state):
+    if is_main_process():
+        trainer.snapshot_jit(state)
+    return state
+"""
+
+_RANK_GATED_EARLY_RETURN = """
+from trlx_tpu.parallel.distributed import is_main_process
+
+def loop(trainer, state):
+    if not is_main_process():
+        return state
+    trainer.push_jit(state)
+    return state
+"""
+
+_RANK_GATED_CLEAN = """
+from trlx_tpu.parallel.distributed import is_main_process
+
+def loop(trainer, state, logger):
+    state, stats = trainer.train_step_jit(state)
+    if is_main_process():
+        logger.info("host-side logging only", stats)
+    return state
+"""
+
+
+def test_rank_gated_dispatch_seeded_and_clean():
+    findings, _ = _lint(_RANK_GATED)
+    assert [f.rule for f in findings] == ["rank-gated-dispatch"]
+    assert findings[0].line == 6
+    assert "rank gate at line 5" in findings[0].message
+
+    findings, _ = _lint(_RANK_GATED_EARLY_RETURN)
+    assert [f.rule for f in findings] == ["rank-gated-dispatch"]
+    assert findings[0].line == 7  # the dispatch after the early return
+
+    findings, _ = _lint(_RANK_GATED_CLEAN)
+    assert findings == []
+
+
+_NONDET_ORDER = """
+import os
+
+def loop(trainer, state):
+    for name in set(os.listdir("ckpts")):
+        state = trainer.eval_jit(state, name)
+    return state
+"""
+
+_NONDET_ORDER_CLEAN = """
+import os
+
+def loop(trainer, state):
+    for name in sorted(os.listdir("ckpts")):
+        state = trainer.eval_jit(state, name)
+    return state
+"""
+
+
+def test_nondet_host_order_seeded_and_clean():
+    findings, _ = _lint(_NONDET_ORDER)
+    assert [f.rule for f in findings] == ["nondet-host-order"]
+    assert "sorted" in findings[0].message
+
+    findings, _ = _lint(_NONDET_ORDER_CLEAN)
+    assert findings == []
+
+
+_HOST_TIME = """
+import time
+from trlx_tpu.parallel.distributed import barrier
+
+def loop(trainer, state, deadline):
+    if time.monotonic() > deadline:
+        barrier("late")
+    return state
+"""
+
+_HOST_TIME_CLEAN = """
+from trlx_tpu.parallel.distributed import barrier
+
+def loop(trainer, state, step):
+    if step % 100 == 0:
+        barrier("century")
+    return state
+"""
+
+
+def test_host_time_in_dispatch_seeded_and_clean():
+    findings, _ = _lint(_HOST_TIME)
+    assert [f.rule for f in findings] == ["host-time-in-dispatch"]
+    assert "wall-clock" in findings[0].message
+
+    findings, _ = _lint(_HOST_TIME_CLEAN)
+    assert findings == []
+
+
+_UNSYNCED_IO = """
+import json
+
+def loop(trainer, state):
+    data = json.load(open("prompts.json"))
+    state, _ = trainer.train_step_jit(state, data)
+    return state
+"""
+
+_UNSYNCED_IO_CLEAN = """
+from trlx_tpu.parallel.distributed import broadcast_host_value
+
+def loop(trainer, state):
+    data = broadcast_host_value({"lr": 0.1})
+    state, _ = trainer.train_step_jit(state, data)
+    return state
+"""
+
+
+def test_unsynced_host_io_seeded_and_clean():
+    findings, _ = _lint(_UNSYNCED_IO)
+    assert [f.rule for f in findings] == ["unsynced-host-io"]
+    assert "broadcast_host_value" in findings[0].message
+
+    findings, _ = _lint(_UNSYNCED_IO_CLEAN)
+    assert findings == []
+
+
+def test_engine12_rules_inline_suppression():
+    # every engine-12 rule honors `# tpu-lint: disable=` on its line
+    seeded = {
+        "rank-gated-dispatch": (_RANK_GATED, 6),
+        "nondet-host-order": (_NONDET_ORDER, 5),
+        "host-time-in-dispatch": (_HOST_TIME, 6),
+        "unsynced-host-io": (_UNSYNCED_IO, 6),
+    }
+    for rule_id, (src, line) in seeded.items():
+        lines = src.splitlines()
+        lines[line - 1] += f"  # tpu-lint: disable={rule_id}"
+        findings, suppressed = _lint("\n".join(lines))
+        assert findings == [], rule_id
+        assert suppressed == 1, rule_id
+
+
+def test_engine12_quiet_on_the_tree():
+    # satellite 1: the in-tree host loops carry no engine-12 findings
+    # (rank gates in telemetry/logging/health are all dispatch-free) —
+    # a new finding here means a new hazard, not a stale test
+    from trlx_tpu.analysis.ast_lint import lint_paths
+
+    findings, _, _ = lint_paths([os.path.join(REPO, "trlx_tpu")])
+    engine12 = {
+        "rank-gated-dispatch",
+        "nondet-host-order",
+        "host-time-in-dispatch",
+        "unsynced-host-io",
+    }
+    hits = [f.format_text() for f in findings if f.rule in engine12]
+    assert hits == [], "\n".join(hits)
+
+
+# ----------------- engine 11: divergence diff (canned logs) --------------- #
+
+def _event(ordinal, program, signature="f32[4]", collectives="",
+           site=None, stack=()):
+    from trlx_tpu.analysis.lockstep import DispatchEvent
+
+    return DispatchEvent(
+        ordinal=ordinal,
+        program=program,
+        signature=signature,
+        collectives=collectives,
+        site=site,
+        stack=tuple(stack),
+    )
+
+
+def _result(kind, logs, hosts=2):
+    from trlx_tpu.analysis.lockstep import LockstepResult
+
+    return LockstepResult(kind=kind, hosts=hosts, mesh={"dp": 2}, logs=logs)
+
+
+def test_diff_host_logs_clean_when_identical():
+    from trlx_tpu.analysis.lockstep import diff_host_logs
+
+    logs = {
+        h: [_event(0, "ilql.sample_jit"), _event(1, "ilql.train_step_jit")]
+        for h in (0, 1, 2, 3)
+    }
+    assert diff_host_logs(_result("ilql", logs, hosts=4)) == []
+
+
+def test_diff_localizes_first_diverging_ordinal_and_guard(tmp_path):
+    from trlx_tpu.analysis.lockstep import diff_host_logs
+
+    # the guard file the stack points into — a real rank gate
+    guard = tmp_path / "host_loop.py"
+    guard.write_text(
+        "from trlx_tpu.parallel.distributed import is_main_process\n"
+        "def loop(trainer, state):\n"
+        "    if is_main_process():\n"
+        "        trainer.snapshot_jit(state)\n"
+    )
+    site = (str(guard), 4)
+    shared = [_event(0, "ppo.sample_jit"), _event(1, "ppo.train_step_jit")]
+    logs = {
+        0: shared + [_event(2, "ppo.snapshot_jit", site=site, stack=[site])],
+        1: list(shared),
+    }
+    findings = diff_host_logs(_result("ppo", logs))
+    assert [f.rule for f in findings] == ["lockstep-divergence"]
+    f = findings[0]
+    assert "ordinal 2" in f.message
+    assert f.file == str(guard)
+    assert f.line == 3  # the `if is_main_process():` line, not the call
+    assert "is_main_process()" in f.message
+    assert f.subject == "ppo@host1"
+    assert "ppo.snapshot_jit: 1 vs 0" in f.message
+
+
+def test_diff_flags_signature_mismatch_at_same_program(tmp_path):
+    from trlx_tpu.analysis.lockstep import diff_host_logs
+
+    logs = {
+        0: [_event(0, "grpo.train_step_jit", signature="bf16[8,16]")],
+        1: [_event(0, "grpo.train_step_jit", signature="bf16[8,32]")],
+    }
+    findings = diff_host_logs(_result("grpo", logs))
+    assert len(findings) == 1
+    assert "ordinal 0" in findings[0].message
+    assert "bf16[8,16]" in findings[0].message
+    assert "bf16[8,32]" in findings[0].message
+
+
+def test_lockstep_divergence_suppressible_at_guard_site(tmp_path):
+    from trlx_tpu.analysis.findings import filter_suppressed
+    from trlx_tpu.analysis.lockstep import diff_host_logs
+
+    guard = tmp_path / "host_loop.py"
+    guard.write_text(
+        "from trlx_tpu.parallel.distributed import is_main_process\n"
+        "def loop(trainer, state):\n"
+        "    if is_main_process():  # tpu-lint: disable=lockstep-divergence\n"
+        "        trainer.snapshot_jit(state)\n"
+    )
+    site = (str(guard), 4)
+    logs = {
+        0: [_event(0, "ppo.snapshot_jit", site=site, stack=[site])],
+        1: [],
+    }
+    findings = diff_host_logs(_result("ppo", logs))
+    assert len(findings) == 1 and findings[0].line == 3
+    kept, suppressed = filter_suppressed(findings)
+    assert kept == [] and suppressed == 1
+
+
+def test_sequence_fingerprint_stable_and_sensitive():
+    from trlx_tpu.analysis.lockstep import sequence_fingerprint
+
+    a = [_event(0, "ilql.sample_jit"), _event(1, "ilql.train_step_jit")]
+    b = [_event(0, "ilql.sample_jit"), _event(1, "ilql.train_step_jit")]
+    assert sequence_fingerprint(a) == sequence_fingerprint(b)
+    # order, signature, and collective schedule all key the fingerprint
+    assert sequence_fingerprint(list(reversed(a))) != sequence_fingerprint(a)
+    c = [_event(0, "ilql.sample_jit", signature="f32[8]"), a[1]]
+    assert sequence_fingerprint(c) != sequence_fingerprint(a)
+    d = [_event(0, "ilql.sample_jit", collectives="psum(dp)"), a[1]]
+    assert sequence_fingerprint(d) != sequence_fingerprint(a)
+
+
+# ------------------- engine 11: lockfile contract ------------------------- #
+
+def test_committed_lockfile_has_lockstep_section():
+    from trlx_tpu.analysis.resource_audit import (
+        default_budgets_path,
+        load_budgets,
+    )
+
+    budgets = load_budgets(default_budgets_path())
+    # one file, four contracts: engines 7, 8, 10, 11
+    assert budgets["programs"], "engine-7 entries missing"
+    assert budgets["compile_budgets"]["programs"], "engine-8 missing"
+    assert budgets["perf_budgets"], "engine-10 missing"
+    section = budgets["lockstep_budgets"]
+    assert section["hosts"] == 2
+    for kind in ("ppo", "ilql", "grpo", "seq2seq"):
+        entry = section["trainers"][kind]
+        assert len(entry["fingerprint"]) == 16, kind
+        assert entry["dispatches"] >= 1, kind
+        assert sum(entry["programs"].values()) == entry["dispatches"], kind
+
+
+def test_check_budgets_missing_section_drift_and_clean():
+    from trlx_tpu.analysis.lockstep import check_lockstep_budgets
+
+    logs = {0: [_event(0, "ilql.train_step_jit")], 1: []}
+    res = _result("ilql", logs)
+    # missing section
+    findings = check_lockstep_budgets([res], {}, "budgets.json")
+    assert [f.rule for f in findings] == ["dispatch-sequence-drift"]
+    assert "no lockstep_budgets section" in findings[0].message
+    # locked fingerprint matches -> clean
+    good = {
+        "lockstep_budgets": {
+            "hosts": 2,
+            "mesh": {"dp": 2},
+            "trainers": {
+                "ilql": {
+                    "fingerprint": res.fingerprint(),
+                    "dispatches": 1,
+                    "programs": res.program_counts(),
+                }
+            },
+        }
+    }
+    assert check_lockstep_budgets([res], good, "budgets.json") == []
+    # drifted fingerprint -> names the per-program delta
+    bad = json.loads(json.dumps(good))
+    bad["lockstep_budgets"]["trainers"]["ilql"]["fingerprint"] = "0" * 16
+    bad["lockstep_budgets"]["trainers"]["ilql"]["programs"] = {
+        "ilql.train_step_jit": 2
+    }
+    findings = check_lockstep_budgets([res], bad, "budgets.json")
+    assert len(findings) == 1
+    assert "drifted" in findings[0].message
+    assert "locked 2, now 1" in findings[0].message
+    # mesh mismatch -> not comparable, no per-trainer noise
+    cross = json.loads(json.dumps(good))
+    cross["lockstep_budgets"]["mesh"] = {"dp": 8}
+    findings = check_lockstep_budgets([res], cross, "budgets.json")
+    assert len(findings) == 1
+    assert "not comparable" in findings[0].message
+
+
+def test_dispatch_sequence_drift_suppressible(tmp_path):
+    # the rule id round-trips through the shared suppression machinery
+    from trlx_tpu.analysis.findings import Finding, filter_suppressed
+    from trlx_tpu.analysis.registry import get_rule
+
+    marked = tmp_path / "loop.py"
+    marked.write_text(
+        "step()  # tpu-lint: disable=dispatch-sequence-drift\n"
+    )
+    rule = get_rule("dispatch-sequence-drift")
+    finding = Finding(
+        rule=rule.id,
+        message="drift",
+        severity=rule.severity,
+        file=str(marked),
+        line=1,
+        subject="ilql",
+        engine="lockstep",
+    )
+    kept, suppressed = filter_suppressed([finding])
+    assert kept == [] and suppressed == 1
+
+
+def _canned_simulate(kind, hosts=2, mesh=None, steps=2, plant=False):
+    logs = {
+        h: [
+            _event(0, f"{kind}.sample_jit"),
+            _event(1, f"{kind}.train_step_jit"),
+        ]
+        for h in range(hosts)
+    }
+    return _result(kind, logs, hosts=hosts)
+
+
+def test_update_budgets_preserves_other_engine_sections(
+    tmp_path, monkeypatch
+):
+    from trlx_tpu.analysis import lockstep
+
+    path = str(tmp_path / "budgets.json")
+    other = {
+        "schema_version": 1,
+        "mesh": {"dp": 2},
+        "tolerance_pct": 10,
+        "programs": {"ppo.train_step": {"peak_hbm_bytes": 123}},
+        "compile_budgets": {"programs": {"ppo.train_step": {"compiles": 2}}},
+        "perf_budgets": {"spans": {"ppo.rollout": {"p50_ms": 5.0}}},
+    }
+    with open(path, "w") as fh:
+        json.dump(other, fh)
+    monkeypatch.setattr(lockstep, "simulate_trainer", _canned_simulate)
+    report, _ = lockstep.audit_lockstep(budgets_path=path, update=True)
+    assert not report.findings
+    with open(path) as fh:
+        merged = json.load(fh)
+    # engines 7, 8, 10 survive the engine-11 relock byte-for-byte
+    for key in ("programs", "compile_budgets", "perf_budgets",
+                "tolerance_pct", "mesh", "schema_version"):
+        assert merged[key] == other[key], key
+    trainers = merged["lockstep_budgets"]["trainers"]
+    assert sorted(trainers) == ["grpo", "ilql", "ppo", "seq2seq"]
+    assert all(e["dispatches"] == 2 for e in trainers.values())
+
+
+def test_update_budgets_partial_merge_keeps_other_kinds(
+    tmp_path, monkeypatch
+):
+    from trlx_tpu.analysis import lockstep
+
+    path = str(tmp_path / "budgets.json")
+    with open(path, "w") as fh:
+        json.dump(
+            {
+                "lockstep_budgets": {
+                    "hosts": 2,
+                    "mesh": {"dp": 2},
+                    "trainers": {
+                        "ilql": {"fingerprint": "aa" * 8, "dispatches": 6,
+                                 "programs": {}},
+                        "ppo": {"fingerprint": "bb" * 8, "dispatches": 9,
+                                "programs": {}},
+                    },
+                }
+            },
+            fh,
+        )
+    monkeypatch.setattr(lockstep, "simulate_trainer", _canned_simulate)
+    report, _ = lockstep.audit_lockstep(
+        kinds=["ppo"], budgets_path=path, update=True
+    )
+    assert not report.findings
+    with open(path) as fh:
+        trainers = json.load(fh)["lockstep_budgets"]["trainers"]
+    # the ppo subset relock replaced ppo's entry, kept ilql's
+    assert trainers["ppo"]["dispatches"] == 2
+    assert trainers["ilql"] == {
+        "fingerprint": "aa" * 8, "dispatches": 6, "programs": {}
+    }
+
+
+def test_update_budgets_refuses_cross_config_partial_relock(
+    tmp_path, monkeypatch
+):
+    from trlx_tpu.analysis import lockstep
+
+    path = str(tmp_path / "budgets.json")
+    locked = {
+        "lockstep_budgets": {
+            "hosts": 8,
+            "mesh": {"dp": 2},
+            "trainers": {"ilql": {"fingerprint": "aa" * 8,
+                                  "dispatches": 6, "programs": {}}},
+        }
+    }
+    with open(path, "w") as fh:
+        json.dump(locked, fh)
+    monkeypatch.setattr(lockstep, "simulate_trainer", _canned_simulate)
+    report, _ = lockstep.audit_lockstep(
+        kinds=["ppo"], budgets_path=path, update=True, hosts=2
+    )
+    assert len(report.findings) == 1
+    assert "refusing --update-budgets" in report.findings[0].message
+    with open(path) as fh:
+        assert json.load(fh) == locked  # nothing was written
+
+
+def test_update_budgets_refuses_on_divergence(tmp_path, monkeypatch):
+    from trlx_tpu.analysis import lockstep
+
+    def diverging(kind, hosts=2, mesh=None, steps=2, plant=False):
+        logs = {0: [_event(0, f"{kind}.sample_jit")], 1: []}
+        return _result(kind, logs, hosts=hosts)
+
+    path = str(tmp_path / "budgets.json")
+    monkeypatch.setattr(lockstep, "simulate_trainer", diverging)
+    report, _ = lockstep.audit_lockstep(
+        kinds=["ilql"], budgets_path=path, update=True
+    )
+    # a diverging schedule is not a contract: the divergence is reported
+    # and no lockfile is written
+    assert [f.rule for f in report.findings] == ["lockstep-divergence"]
+    assert not os.path.exists(path)
+
+
+# -------------------- engine 11: real-simulation canary ------------------- #
+
+def test_ilql_two_host_lockstep_and_planted_divergence():
+    # ONE real 2-host simulation serves both tier-1 canaries: with the
+    # planted rank-0-only dispatch, host 0's log is the clean log plus
+    # one trailing event — so (a) every ordinal before the plant must
+    # agree across hosts (the clean-loop lockstep claim), and (b) the
+    # diff must localize the divergence to the planted guard (the
+    # detection claim)
+    from trlx_tpu.analysis import lockstep
+
+    res = lockstep.simulate_trainer("ilql", hosts=2, plant=True)
+    ref, other = res.logs[0], res.logs[1]
+    # the planted rank-0 sample() appends extra trailing dispatches on
+    # host 0 only (sample dispatches the cast program too)
+    assert len(ref) > len(other)
+    for e0, e1 in zip(ref, other):
+        assert e0.key() == e1.key(), (e0, e1)
+
+    findings = lockstep.diff_host_logs(res)
+    assert [f.rule for f in findings] == ["lockstep-divergence"]
+    f = findings[0]
+    assert f.file.endswith("analysis/lockstep.py")
+    assert f"ordinal {len(other)}" in f.message
+    assert "is_main_process()" in f.message
+    assert "ilql." in f.message
+
+    # the un-planted prefix IS the committed contract: its fingerprint
+    # must match the locked one, so the canary also proves the clean
+    # run gates green against budgets.json
+    from trlx_tpu.analysis.resource_audit import (
+        default_budgets_path,
+        load_budgets,
+    )
+
+    locked = load_budgets(default_budgets_path())["lockstep_budgets"]
+    assert (
+        lockstep.sequence_fingerprint(other)
+        == locked["trainers"]["ilql"]["fingerprint"]
+    )
+
+
+@pytest.mark.slow  # full matrix: 4 trainers × {2,4} hosts, nightly tier
+@pytest.mark.parametrize("kind", ["ppo", "ilql", "grpo", "seq2seq"])
+@pytest.mark.parametrize("hosts", [2, 4])
+def test_every_trainer_lockstep_matrix(kind, hosts):
+    from trlx_tpu.analysis import lockstep
+
+    res = lockstep.simulate_trainer(kind, hosts=hosts)
+    assert lockstep.diff_host_logs(res) == []
+    assert res.dispatches() >= 1
+    # host count must not change the schedule: the fingerprint matches
+    # the committed 2-host contract
+    from trlx_tpu.analysis.resource_audit import (
+        default_budgets_path,
+        load_budgets,
+    )
+
+    locked = load_budgets(default_budgets_path())["lockstep_budgets"]
+    assert res.fingerprint() == locked["trainers"][kind]["fingerprint"]
+
+
+@pytest.mark.slow  # subprocess CLI round-trip, nightly tier (CI runs the
+# same commands in the lockstep-smoke job)
+def test_cli_planted_divergence_exits_nonzero():
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "trlx_tpu.analysis", "--lockstep",
+            "--hosts", "2", "--trainers", "ppo", "--plant-divergence",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "lockstep-divergence" in out.stdout
+    assert "analysis/lockstep.py" in out.stdout

@@ -41,6 +41,16 @@ safety properties the fsdp/tp NaN divergence exposed:
   per-span wall-clock (p50) against the ``perf_budgets`` lockfile
   section (rule ``perf-regression``) — the first engine watching a
   *run*, not a trace; see docs/observability.md.
+- :mod:`trlx_tpu.analysis.lockstep` — ``--lockstep`` simulates each
+  trainer's canonical loop as N controller processes (per-thread
+  ``jax.process_index``/rank-0 gates), records every jitted/
+  collective-bearing dispatch per host, diffs the logs (rule
+  ``lockstep-divergence``) and gates host-0 dispatch fingerprints
+  against the ``lockstep_budgets`` lockfile section (rule
+  ``dispatch-sequence-drift``); its static half is the engine-12
+  host-concurrency rules in ``ast_lint`` (``rank-gated-dispatch``,
+  ``nondet-host-order``, ``host-time-in-dispatch``,
+  ``unsynced-host-io``), run by ``--engine all``/``ast``.
 
 Run ``python -m trlx_tpu.analysis --help`` or see docs/static_analysis.md.
 """

@@ -8,10 +8,12 @@ JAX initializes so collective/sharding structure is real.
 Besides the rule engines there are report modes: ``--sanitize
 <trainer>`` (eqn-level non-finite replay), ``--resources`` (static
 peak-HBM / collective / FLOP budgets per traced program), ``--compile-
-audit`` (runtime compile counting), and ``--perf-audit`` (measured
-per-span wall-clock over the instrumented phase loop) — the latter
-three gated against the committed ``analysis/budgets.json`` with
-``--update-budgets`` relocking each engine's own section. JSON output
+audit`` (runtime compile counting), ``--perf-audit`` (measured
+per-span wall-clock over the instrumented phase loop), and
+``--lockstep`` (N simulated controller processes diffing per-host
+dispatch logs) — the latter four gated against the committed
+``analysis/budgets.json`` with ``--update-budgets`` relocking each
+engine's own section. JSON output
 carries a top-level ``schema_version`` and deterministic ordering so CI
 artifacts diff cleanly.
 """
@@ -70,6 +72,32 @@ def main(argv=None) -> int:
         "compile_budgets section of analysis/budgets.json, and diff "
         "step-0 vs step-k jaxprs on any steady-state retrace "
         "(--update-budgets relocks the counts)",
+    )
+    parser.add_argument(
+        "--lockstep",
+        action="store_true",
+        help="instead of the rule engines: simulate each trainer's "
+        "canonical loop as N controller processes (threads with "
+        "per-thread jax.process_index/process_count and rank-0 gates), "
+        "record every jitted/collective-bearing dispatch per host, diff "
+        "the logs (any divergence is a future multi-host deadlock, "
+        "localized to ordinal + file:line + guarding branch), and gate "
+        "host-0 dispatch fingerprints against the lockstep_budgets "
+        "section of analysis/budgets.json (--update-budgets relocks)",
+    )
+    parser.add_argument(
+        "--hosts",
+        type=int,
+        default=2,
+        help="with --lockstep: number of simulated controller processes "
+        "(default 2)",
+    )
+    parser.add_argument(
+        "--plant-divergence",
+        action="store_true",
+        help="with --lockstep: plant one rank-0-only dispatch at the end "
+        "of the loop — self-check that the simulator localizes exactly "
+        "this hazard (budget gating is skipped; exit must be 1)",
     )
     parser.add_argument(
         "--resources",
@@ -274,6 +302,40 @@ def main(argv=None) -> int:
         if args.trainers
         else None
     )
+
+    if args.lockstep:
+        _force_cpu_platform()
+        from trlx_tpu.analysis.lockstep import (
+            audit_lockstep,
+            format_lockstep_text,
+        )
+
+        report, results = audit_lockstep(
+            kinds=trainers,
+            hosts=args.hosts,
+            mesh=mesh,
+            budgets_path=args.budgets,
+            update=args.update_budgets,
+            plant=args.plant_divergence,
+        )
+        if args.json:
+            report.resources = [r.to_row() for r in results]
+            print(report.to_json())
+        else:
+            print(format_lockstep_text(results))
+            if args.update_budgets and not report.findings:
+                print(
+                    "lockstep budgets written — review and commit the "
+                    "lockfile diff"
+                )
+            if report.findings:
+                print(report.format_text())
+        if args.update_budgets:
+            # findings here mean the update was REFUSED (diverging
+            # schedule, or cross-mesh/hosts partial relock) and nothing
+            # was written
+            return 1 if report.findings else 0
+        return report.exit_code(strict=args.strict)
 
     if args.compile_audit:
         _force_cpu_platform()

@@ -27,6 +27,28 @@ on different hosts of a multi-host slice; if any arm dispatches device
 work, the next collective hangs (LlamaRL: all workers must execute one
 schedule). Branch on config/step counters instead, or all-gather first.
 
+Engine 12 — the host-concurrency rules (the static half of the
+multi-controller lockstep auditor in ``lockstep.py``) — also runs on the
+untraced (host-loop) functions. A "dispatch-bearing" call here is a
+``*_jit`` call site or a host collective (``barrier`` /
+``sync_global_devices`` / ``broadcast_one_to_all`` /
+``broadcast_host_value`` / ``process_allgather``):
+
+- ``rank-gated-dispatch``: a dispatch-bearing call reachable only under
+  a ``process_index() == 0`` / ``is_main_process()`` / ``.is_main``
+  branch (including the early-return form ``if not is_main_process():
+  return`` followed by a dispatch) — host 0 enters a collective its
+  peers never dispatch.
+- ``nondet-host-order``: iteration over ``set(...)`` / un-sorted
+  ``os.listdir`` / ``glob`` whose loop body (or a dispatch argument)
+  dispatches — per-process iteration order IS the dispatch order.
+- ``host-time-in-dispatch``: wall-clock (``time.time``/``monotonic``/
+  ``datetime.now``) or host ``random`` steering a branch that guards a
+  dispatch — per-process clocks flip the branch at different moments.
+- ``unsynced-host-io``: a value read from a per-host file
+  (``open``/``.read``/``np.load``/``json.load``) feeding a dispatch's
+  arguments — per-host reads can observe different snapshots.
+
 The traced-region computation is a static over/under-approximation: calls
 through containers, getattr strings, or cross-module helpers are not
 followed. False positives are silenced inline with
@@ -449,6 +471,348 @@ class _HostBranchLinter(ast.NodeVisitor):
     visit_AsyncFunctionDef = _skip_nested_def
 
 
+# ------------------ engine 12: host-concurrency rules -------------------- #
+
+# host-side collective entry points: rank-gating one of these is the
+# textbook multi-controller deadlock (every host must reach the barrier)
+_HOST_COLLECTIVE_CALLS = {
+    "barrier", "sync_global_devices", "broadcast_one_to_all",
+    "broadcast_host_value", "process_allgather",
+}
+
+_WALL_CLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+
+# attribute-call leaves whose result is per-host file content
+_IO_READ_ATTRS = {"read", "readlines", "read_text", "read_bytes"}
+_IO_READ_DOTTED = {
+    "json.load", "pickle.load", "yaml.safe_load", "yaml.load",
+}
+_IO_NUMPY_LEAVES = {"load", "loadtxt", "genfromtxt", "fromfile"}
+
+
+def _is_rank_test(node: ast.AST) -> bool:
+    """Whether an ``if``/``while`` test reads the process rank:
+    ``is_main_process()``, ``process_index()`` comparisons, or an
+    ``is_main`` attribute/name. Also used by the lockstep simulator
+    (engine 11) to attribute a diverging dispatch to its guard."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = _dotted_name(sub.func)
+            if name and name.split(".")[-1] in (
+                "is_main_process", "process_index",
+            ):
+                return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "is_main":
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "is_main":
+            return True
+    return False
+
+
+def _dispatch_call_name(call: ast.Call) -> Optional[str]:
+    """The dotted name of a dispatch-bearing call: a ``*_jit`` call site
+    or a host collective; ``None`` for plain host calls."""
+    name = _dotted_name(call.func)
+    if name is None:
+        return None
+    leaf = name.split(".")[-1]
+    if leaf.endswith("_jit") or leaf in _HOST_COLLECTIVE_CALLS:
+        return name
+    return None
+
+
+def _dispatch_calls_in(nodes: Iterable[ast.AST]) -> List[Tuple[ast.Call, str]]:
+    out: List[Tuple[ast.Call, str]] = []
+    for node in nodes:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _dispatch_call_name(sub)
+                if name is not None:
+                    out.append((sub, name))
+    return out
+
+
+def _nondet_iter_reason(expr: ast.AST) -> Optional[str]:
+    """Why iterating ``expr`` has process-local order; ``None`` when the
+    outermost expression pins the order (``sorted(...)`` exempts)."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = _dotted_name(expr.func) or ""
+    leaf = name.split(".")[-1]
+    if leaf == "sorted":
+        return None
+    if leaf == "set":
+        return "set() iteration order is process-local"
+    if leaf == "listdir":
+        return "os.listdir() returns entries in filesystem order"
+    if leaf in ("glob", "iglob", "rglob"):
+        return "glob order follows the per-host directory walk"
+    return None
+
+
+def _wall_clock_or_random_reason(
+    test: ast.AST, aliases: _ImportAliases
+) -> Optional[str]:
+    for sub in ast.walk(test):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = _dotted_name(sub.func)
+        if name is None:
+            continue
+        parts = name.split(".")
+        if name in _WALL_CLOCK_CALLS or (
+            len(parts) >= 2
+            and parts[-1] in ("time", "monotonic", "perf_counter")
+            and parts[-2] == "time"
+        ) or (
+            parts[-1] in ("now", "utcnow") and "datetime" in parts
+        ):
+            return f"wall-clock `{name}()`"
+        if parts[0] in aliases.random and len(parts) > 1:
+            return f"host random `{name}()`"
+        if (
+            parts[0] in (aliases.numpy | {"np"})
+            and "random" in parts[:-1]
+        ):
+            return f"host random `{name}()`"
+    return None
+
+
+def _is_io_read_value(value: ast.AST, aliases: _ImportAliases) -> bool:
+    """Whether an assignment's value subtree reads per-host file
+    content: ``open(...)``, ``fh.read*/path.read_*``, ``np.load``-family,
+    ``json.load``/``pickle.load``/``yaml.*load``."""
+    for sub in ast.walk(value):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        if isinstance(func, ast.Name) and func.id == "open":
+            return True
+        name = _dotted_name(func)
+        if name is None:
+            if isinstance(func, ast.Attribute) and func.attr in (
+                _IO_READ_ATTRS
+            ):
+                return True
+            continue
+        parts = name.split(".")
+        if parts[-1] in _IO_READ_ATTRS:
+            return True
+        if name in _IO_READ_DOTTED or any(
+            name.endswith("." + d) for d in _IO_READ_DOTTED
+        ):
+            return True
+        if (
+            parts[0] in (aliases.numpy | {"np"})
+            and parts[-1] in _IO_NUMPY_LEAVES
+        ):
+            return True
+    return False
+
+
+def _is_terminal(stmt: ast.stmt) -> bool:
+    return isinstance(stmt, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+class _HostConcurrencyLinter(ast.NodeVisitor):
+    """Engine 12: multi-controller hazards in one untraced (host-loop)
+    function — rank-gated dispatch, nondeterministic dispatch order,
+    clock/random-steered dispatch, unsynced per-host I/O into dispatch."""
+
+    def __init__(
+        self, path: str, subject: str, aliases: _ImportAliases, func_node
+    ) -> None:
+        self.path = path
+        self.subject = subject
+        self.aliases = aliases
+        self.findings: List[Finding] = []
+        # taint pre-pass: locals carrying per-host file content
+        self._io_tainted: Set[str] = set()
+        for sub in ast.walk(func_node):
+            if isinstance(sub, ast.Assign) and _is_io_read_value(
+                sub.value, aliases
+            ):
+                for target in sub.targets:
+                    names = (
+                        target.elts
+                        if isinstance(target, (ast.Tuple, ast.List))
+                        else [target]
+                    )
+                    for n in names:
+                        if isinstance(n, ast.Name):
+                            self._io_tainted.add(n.id)
+        # statement-block scan for the early-return rank-gate form
+        self._scan_blocks(func_node)
+
+    def _add(self, rule_id: str, node: ast.AST, message: str) -> None:
+        rule = get_rule(rule_id)
+        self.findings.append(
+            Finding(
+                rule=rule.id,
+                message=message,
+                severity=rule.severity,
+                file=self.path,
+                line=getattr(node, "lineno", None),
+                subject=self.subject,
+                engine="ast",
+            )
+        )
+
+    # -------------------- rank-gated-dispatch -------------------- #
+
+    def _scan_blocks(self, root: ast.AST) -> None:
+        """``if <rank-test>: return`` makes every later statement in the
+        same block rank-conditional — a dispatch there is exactly as
+        gated as one inside the branch body."""
+        for node in ast.walk(root):
+            body = getattr(node, "body", None)
+            if not isinstance(body, list):
+                continue
+            for stmts in (body, getattr(node, "orelse", []) or []):
+                if not isinstance(stmts, list):
+                    continue
+                gate: Optional[ast.If] = None
+                for stmt in stmts:
+                    if (
+                        gate is not None
+                        and not isinstance(stmt, ast.FunctionDef)
+                    ):
+                        for call, name in _dispatch_calls_in([stmt]):
+                            self._add(
+                                "rank-gated-dispatch", call,
+                                f"`{name}` dispatches only when the rank "
+                                f"gate at line {gate.lineno} falls "
+                                "through — the other hosts exit early "
+                                "and never enter this program's "
+                                "collectives",
+                            )
+                    if (
+                        isinstance(stmt, ast.If)
+                        and _is_rank_test(stmt.test)
+                        and stmt.body
+                        and _is_terminal(stmt.body[-1])
+                        and not stmt.orelse
+                    ):
+                        gate = stmt
+
+    def visit_If(self, node: ast.If) -> None:
+        if _is_rank_test(node.test):
+            for arm in (node.body, node.orelse):
+                for call, name in _dispatch_calls_in(arm):
+                    self._add(
+                        "rank-gated-dispatch", call,
+                        f"`{name}` dispatches under the rank gate at "
+                        f"line {node.lineno} — the hosts on the other "
+                        "arm never dispatch it, so its first collective "
+                        "blocks the gated host(s) forever; rank-gate "
+                        "host I/O, never device dispatch",
+                    )
+        else:
+            self._check_guarded_dispatch(node)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_guarded_dispatch(node)
+        self.generic_visit(node)
+
+    # ------------------- host-time-in-dispatch ------------------- #
+
+    def _check_guarded_dispatch(self, node) -> None:
+        reason = _wall_clock_or_random_reason(node.test, self.aliases)
+        if reason is None:
+            return
+        dispatches = _dispatch_calls_in(node.body) + _dispatch_calls_in(
+            node.orelse or []
+        )
+        if not dispatches:
+            return
+        _, name = dispatches[0]
+        self._add(
+            "host-time-in-dispatch", node,
+            f"branch steered by {reason} guards the dispatch of "
+            f"`{name}` — per-host clocks/RNG flip this branch at "
+            "different moments on different hosts, desynchronizing the "
+            "dispatch schedule; derive the decision from step counters "
+            "or broadcast it from rank 0",
+        )
+
+    # --------------------- nondet-host-order --------------------- #
+
+    def visit_For(self, node: ast.For) -> None:
+        reason = _nondet_iter_reason(node.iter)
+        if reason is not None:
+            dispatches = _dispatch_calls_in(node.body)
+            if dispatches:
+                _, name = dispatches[0]
+                self._add(
+                    "nondet-host-order", node,
+                    f"loop iterates in nondeterministic order ({reason}) "
+                    f"and dispatches `{name}` in its body — "
+                    "multi-controller lockstep requires every host to "
+                    "dispatch in ONE order; wrap the iterable in "
+                    "sorted(...)",
+                )
+        self.generic_visit(node)
+
+    # ---------------------- unsynced-host-io ---------------------- #
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dispatch_call_name(node)
+        if name is not None:
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for a in args:
+                reason = None
+                if _is_io_read_value(a, self.aliases):
+                    reason = "reads a per-host file inline"
+                else:
+                    for sub in ast.walk(a):
+                        if (
+                            isinstance(sub, ast.Name)
+                            and isinstance(sub.ctx, ast.Load)
+                            and sub.id in self._io_tainted
+                        ):
+                            reason = (
+                                f"`{sub.id}` was read from a per-host "
+                                "file"
+                            )
+                            break
+                if reason is not None:
+                    self._add(
+                        "unsynced-host-io", node,
+                        f"`{name}` is fed a value that {reason} — "
+                        "per-host reads can observe different "
+                        "snapshots, so shapes/values (and the jit "
+                        "cache key) can differ across hosts; read on "
+                        "rank 0 and broadcast_host_value, or restore "
+                        "through the checkpoint layer",
+                    )
+                    break
+                # nondet order feeding a dispatch argument directly
+                if isinstance(a, ast.Call):
+                    nondet = _nondet_iter_reason(a)
+                    if nondet is not None:
+                        self._add(
+                            "nondet-host-order", node,
+                            f"`{name}` argument is built from a "
+                            f"nondeterministically-ordered collection "
+                            f"({nondet}) — its contents differ by "
+                            "host-local order; wrap in sorted(...)",
+                        )
+                        break
+        self.generic_visit(node)
+
+    def _skip_nested_def(self, node) -> None:
+        # nested defs lint under their own (traced/host) classification
+        return
+
+    visit_FunctionDef = _skip_nested_def
+    visit_AsyncFunctionDef = _skip_nested_def
+
+
 class _OpsNumpyLinter(ast.NodeVisitor):
     """np-in-ops: no `np.` inside any function body of an ops/ module."""
 
@@ -519,7 +883,8 @@ def lint_source(
                 linter.visit(stmt)
             findings.extend(linter.findings)
 
-    # host-loop (untraced) functions: SPMD-desync branch rule
+    # host-loop (untraced) functions: SPMD-desync branch rule plus the
+    # engine-12 host-concurrency rules (multi-controller lockstep)
     for name in sorted(set(index.defs) - traced):
         for node in index.defs.get(name, ()):
             host_linter = _HostBranchLinter(
@@ -528,6 +893,12 @@ def lint_source(
             for stmt in node.body:
                 host_linter.visit(stmt)
             findings.extend(host_linter.findings)
+            conc_linter = _HostConcurrencyLinter(
+                path, f"{name}()", aliases, node
+            )
+            for stmt in node.body:
+                conc_linter.visit(stmt)
+            findings.extend(conc_linter.findings)
 
     # lambdas passed directly to trace entries (no named def to index)
     class _LambdaArgs(ast.NodeVisitor):

@@ -375,6 +375,8 @@ def drive_trainer(
     mesh: Optional[Dict[str, int]] = None,
     monitor: Optional[CompileMonitor] = None,
     steps: int = 2,
+    instrument=None,
+    train_overrides: Optional[Dict] = None,
 ) -> Tuple[List[DrivenProgram], CompileMonitor, Dict[str, int]]:
     """Run ``kind``'s canonical short loop under a compile monitor.
 
@@ -385,6 +387,12 @@ def drive_trainer(
     compile in the second pass is an unexpected retrace. The train step's
     inputs are signature-captured at step 0 and step k, and re-traced at
     the end (tracing is compile-free) for the drift diff.
+
+    ``instrument``, when given, is called with the freshly built trainer
+    before any program runs — the lockstep simulator (engine 11) uses it
+    to wrap every ``*_jit`` attribute with a dispatch recorder, so both
+    engines share ONE canonical loop instead of drifting copies.
+    ``train_overrides`` forwards to the harness config (same reason).
     """
     import jax
 
@@ -400,7 +408,11 @@ def drive_trainer(
         from trlx_tpu.parallel.mesh import batch_sharding
 
         nonlocal mesh_shape
-        trainer = harness.build_trainer(kind, mesh)
+        trainer = harness.build_trainer(
+            kind, mesh, train_overrides=train_overrides
+        )
+        if instrument is not None:
+            instrument(trainer)
         mesh_shape.update(
             {k: int(v) for k, v in trainer.mesh.shape.items()}
         )

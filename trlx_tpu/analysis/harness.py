@@ -82,9 +82,18 @@ def _base_train(mesh: Dict[str, int]) -> Dict[str, Any]:
     }
 
 
-def tiny_config_dict(kind: str, mesh: Optional[Dict[str, int]] = None) -> Dict:
+def tiny_config_dict(
+    kind: str,
+    mesh: Optional[Dict[str, int]] = None,
+    train_overrides: Optional[Dict[str, Any]] = None,
+) -> Dict:
     mesh = dict(mesh or audit_mesh_config())
     train = _base_train(mesh)
+    # harness-level knobs (the lockstep simulator enables train.health so
+    # the rank-0 monitor/flight-recorder construction paths are exercised
+    # per simulated host); applied before the per-kind sections so those
+    # keep the last word on their own keys
+    train.update(dict(train_overrides or {}))
     if kind in ("ppo", "grpo"):
         method: Dict[str, Any] = {
             "name": "GRPOConfig" if kind == "grpo" else "PPOConfig",
@@ -146,10 +155,16 @@ def tiny_config_dict(kind: str, mesh: Optional[Dict[str, int]] = None) -> Dict:
     raise ValueError(f"unknown trainer kind {kind!r}; know {TRAINER_KINDS}")
 
 
-def build_trainer(kind: str, mesh: Optional[Dict[str, int]] = None):
+def build_trainer(
+    kind: str,
+    mesh: Optional[Dict[str, int]] = None,
+    train_overrides: Optional[Dict[str, Any]] = None,
+):
     from trlx_tpu.data.configs import TRLConfig
 
-    config = TRLConfig.from_dict(tiny_config_dict(kind, mesh))
+    config = TRLConfig.from_dict(
+        tiny_config_dict(kind, mesh, train_overrides=train_overrides)
+    )
     if kind in ("ppo",):
         from trlx_tpu.trainer.ppo_trainer import PPOTrainer
 

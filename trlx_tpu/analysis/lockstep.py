@@ -1,0 +1,881 @@
+"""Multi-controller lockstep simulator — engine 11.
+
+In multi-controller JAX (the LlamaRL / direction-1 deployment shape)
+every host runs its OWN Python training loop and must dispatch the same
+jitted and collective-bearing programs in the same order with the same
+abstract signatures — the compiled programs contain cross-host
+collectives, so a dispatch present on one host and absent (or shaped
+differently) on another leaves its peers blocked inside the program's
+first collective until the job is killed. Nothing in engines 1–10 can
+see this: they all analyze ONE controller's schedule.
+
+This engine simulates N controller processes before any multi-host
+hardware exists:
+
+- each simulated host runs the trainer's canonical short loop — the
+  SAME loop as the compile audit (``compile_audit.drive_trainer``
+  with an instrumentation hook), so the audited schedule is the
+  contract schedule, not a drifting copy;
+- hosts execute as sequential threads over per-host views of the
+  virtual global mesh, with the public ``jax.process_index()`` /
+  ``jax.process_count()`` patched thread-locally — so every rank-0
+  gate in the tree (telemetry tracer, ``Logger.is_main``, the health
+  monitor / flight recorder construction, the run-ledger manifest)
+  takes its REAL per-host arm;
+- host-side collectives (``multihost_utils.sync_global_devices`` /
+  ``broadcast_one_to_all`` / ``process_allgather``) are stubbed to
+  record-and-simulate: they are dispatch events like any jitted call
+  (a rank-gated barrier is the classic deadlock), executed locally;
+- every dispatch is recorded as an event: program name, canonicalized
+  arg shape/dtype signature, the program's collective sequence (via
+  engine 5's extractor), and its dispatch ordinal — into one log per
+  host;
+- the logs are diffed across hosts: any divergence is a future
+  multi-host deadlock, localized to the first diverging ordinal, the
+  owning call site, and — when a stack frame sits under a
+  ``process_index()==0`` / ``is_main_process()`` branch — the guarding
+  branch itself, plus a per-host dispatch-count diff
+  (rule ``lockstep-divergence``).
+
+Host-0's per-trainer dispatch sequence also locks into the
+``lockstep_budgets`` section of ``analysis/budgets.json`` as a
+fingerprint (rule ``dispatch-sequence-drift``): intentional schedule
+changes ship as reviewable lockfile diffs via ``--lockstep
+--update-budgets`` (the relock preserves the other engines' sections,
+per the established contract).
+
+CLI: ``python -m trlx_tpu.analysis --lockstep [--hosts N]
+[--trainers ...] [--update-budgets] [--plant-divergence]``. The static
+half of this story is engine 12 (the host-concurrency rules in
+``ast_lint.py``); see docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import threading
+from collections import Counter
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from trlx_tpu.analysis.findings import Finding, Report, filter_suppressed
+from trlx_tpu.analysis.registry import get_rule
+
+_THIS_FILE = os.path.abspath(__file__)
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(_THIS_FILE)))
+
+# thread-local simulated controller identity; unset outside a simulation
+_TLS = threading.local()
+
+# function names inside this module that sit between a dispatch and the
+# code that made it — skipped when attributing a call site
+_MACHINERY = {
+    "_repo_stack", "record", "record_host_collective", "dispatch",
+    "_sim_sync_global_devices", "_sim_broadcast_one_to_all",
+    "_sim_process_allgather",
+}
+
+
+# --------------------------- simulated identity --------------------------- #
+
+def _sim_state() -> Tuple[Optional[int], Optional[int]]:
+    return getattr(_TLS, "index", None), getattr(_TLS, "count", None)
+
+
+@contextmanager
+def simulated_hosts(hosts: int):
+    """Patch the public ``jax.process_index``/``jax.process_count`` (and
+    the ``multihost_utils`` host collectives) with thread-local-aware
+    versions. Code on a thread without a simulated identity — including
+    every caller outside a simulation — sees the real functions; jax
+    internals read ``xla_bridge`` directly and are untouched, so device
+    placement and compilation behave exactly as before."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    real_index = jax.process_index
+    real_count = jax.process_count
+    real_sync = multihost_utils.sync_global_devices
+    real_bcast = multihost_utils.broadcast_one_to_all
+    real_gather = multihost_utils.process_allgather
+
+    def sim_index() -> int:
+        idx, _ = _sim_state()
+        return real_index() if idx is None else idx
+
+    def sim_count() -> int:
+        _, cnt = _sim_state()
+        return real_count() if cnt is None else cnt
+
+    def _sim_sync_global_devices(name: str = "sync"):
+        rec = getattr(_TLS, "recorder", None)
+        if rec is None:
+            return real_sync(name)
+        rec.record_host_collective(
+            "host.sync_global_devices", str(name), "sync_global_devices"
+        )
+        return None
+
+    def _sim_broadcast_one_to_all(x, is_source=None):
+        rec = getattr(_TLS, "recorder", None)
+        if rec is None:
+            return real_bcast(x, is_source=is_source)
+        rec.record_host_collective(
+            "host.broadcast_one_to_all",
+            canonical_signature((x,), {}),
+            "broadcast_one_to_all",
+        )
+        # every simulated host holds the same loop state, so the local
+        # value IS the rank-0 value
+        return x
+
+    def _sim_process_allgather(x, tiled: bool = False):
+        import numpy as np
+
+        rec = getattr(_TLS, "recorder", None)
+        if rec is None:
+            return real_gather(x, tiled=tiled)
+        rec.record_host_collective(
+            "host.process_allgather",
+            canonical_signature((x,), {}),
+            "process_allgather",
+        )
+        _, cnt = _sim_state()
+        import jax as _jax
+
+        return _jax.tree_util.tree_map(
+            lambda leaf: np.stack([np.asarray(leaf)] * int(cnt or 1)), x
+        )
+
+    jax.process_index = sim_index
+    jax.process_count = sim_count
+    multihost_utils.sync_global_devices = _sim_sync_global_devices
+    multihost_utils.broadcast_one_to_all = _sim_broadcast_one_to_all
+    multihost_utils.process_allgather = _sim_process_allgather
+    try:
+        yield
+    finally:
+        jax.process_index = real_index
+        jax.process_count = real_count
+        multihost_utils.sync_global_devices = real_sync
+        multihost_utils.broadcast_one_to_all = real_bcast
+        multihost_utils.process_allgather = real_gather
+
+
+@contextmanager
+def host_identity(host: int, hosts: int, recorder: "DispatchRecorder"):
+    """One simulated controller's view: thread-local rank plus a fresh
+    process-global tracer whose enabled flag follows the simulated rank
+    (production gates the tracer on ``is_main_process()`` at first use;
+    the global may already exist here, so it is swapped explicitly)."""
+    from trlx_tpu import telemetry
+    from trlx_tpu.telemetry.tracer import Tracer
+
+    _TLS.index, _TLS.count, _TLS.recorder = host, hosts, recorder
+    try:
+        with telemetry.scoped_tracer(Tracer(enabled=(host == 0))):
+            yield
+    finally:
+        _TLS.index = _TLS.count = _TLS.recorder = None
+
+
+# ------------------------------ dispatch log ------------------------------ #
+
+@dataclass
+class DispatchEvent:
+    """One jitted (or host-collective) dispatch on one simulated host."""
+
+    ordinal: int
+    program: str
+    signature: str  # canonical arg shape/dtype signature
+    collectives: str  # canonical collective sequence of the program
+    site: Optional[Tuple[str, int]] = None  # innermost repo call site
+    stack: Tuple[Tuple[str, int], ...] = ()
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.program, self.signature, self.collectives)
+
+    def describe(self) -> str:
+        sig = self.signature
+        if len(sig) > 120:
+            sig = sig[:117] + "..."
+        coll = f" collectives[{self.collectives}]" if self.collectives else ""
+        return f"`{self.program}({sig})`{coll}"
+
+
+def canonical_signature(args, kwargs) -> str:
+    """Shape/dtype signature over the flattened (args, kwargs) pytree —
+    the part of a dispatch that keys the jit cache. Python ints/bools
+    keep their value (static-arg semantics); array values do not."""
+    import jax
+
+    parts: List[str] = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            weak = "~w" if getattr(leaf, "weak_type", False) else ""
+            dims = ",".join(str(int(d)) for d in shape)
+            parts.append(f"{dtype}[{dims}]{weak}")
+        elif isinstance(leaf, (bool, int, str)):
+            parts.append(f"{type(leaf).__name__}:{leaf}")
+        else:
+            parts.append(type(leaf).__name__)
+    return ",".join(parts)
+
+
+def _repo_stack(limit: int = 6) -> List[Tuple[str, int]]:
+    """Innermost-first repo frames above the recording machinery."""
+    import sys
+
+    out: List[Tuple[str, int]] = []
+    frame = sys._getframe(1)
+    while frame is not None and len(out) < limit:
+        fname = os.path.abspath(frame.f_code.co_filename)
+        machinery = (
+            fname == _THIS_FILE and frame.f_code.co_name in _MACHINERY
+        )
+        if not machinery and fname.startswith(_REPO_ROOT + os.sep):
+            out.append((fname, frame.f_lineno))
+        frame = frame.f_back
+    return out
+
+
+class DispatchRecorder:
+    """Per-(host, trainer) dispatch log. ``trace_cache`` is shared across
+    the hosts of one simulation so each program's collective sequence is
+    extracted once, not once per host."""
+
+    def __init__(
+        self, kind: str, host: int, trace_cache: Dict[Tuple[str, str], str]
+    ) -> None:
+        self.kind = kind
+        self.host = host
+        self.events: List[DispatchEvent] = []
+        self._trace_cache = trace_cache
+
+    def record(self, program: str, fn, args, kwargs) -> None:
+        import jax
+
+        tracer_cls = getattr(jax.core, "Tracer", ())
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        if any(isinstance(leaf, tracer_cls) for leaf in leaves):
+            # an abstract trace of the wrapped callable (make_jaxpr /
+            # eval_shape in the drift diff) — not a dispatch
+            return
+        sig = canonical_signature(args, kwargs)
+        coll = self._collectives(program, fn, args, kwargs, sig)
+        stack = _repo_stack()
+        self.events.append(
+            DispatchEvent(
+                ordinal=len(self.events),
+                program=program,
+                signature=sig,
+                collectives=coll,
+                site=stack[0] if stack else None,
+                stack=tuple(stack),
+            )
+        )
+
+    def record_host_collective(
+        self, program: str, signature: str, collective: str
+    ) -> None:
+        stack = _repo_stack()
+        self.events.append(
+            DispatchEvent(
+                ordinal=len(self.events),
+                program=program,
+                signature=signature,
+                collectives=collective,
+                site=stack[0] if stack else None,
+                stack=tuple(stack),
+            )
+        )
+
+    def _collectives(self, program, fn, args, kwargs, sig) -> str:
+        key = (program, sig)
+        if key not in self._trace_cache:
+            import jax
+
+            from trlx_tpu.analysis.collective_trace import collective_sequence
+
+            try:
+                jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+                seq = collective_sequence(jaxpr)
+                self._trace_cache[key] = ";".join(
+                    f"{prim}({','.join(axes)})" for prim, axes, _ in seq
+                )
+            except Exception:
+                self._trace_cache[key] = "<untraceable>"
+        return self._trace_cache[key]
+
+
+def _instrument_trainer(trainer, kind: str, recorder: DispatchRecorder):
+    """Replace every callable ``*_jit`` attribute on the trainer (and,
+    for ppo, its rollout engine) with a recording proxy. The inner jit
+    callable is preserved on ``__wrapped__`` so the compile monitor's
+    log-name attribution keeps working."""
+
+    def wrap(program: str, fn):
+        def dispatch(*args, **kwargs):
+            recorder.record(program, fn, args, kwargs)
+            return fn(*args, **kwargs)
+
+        dispatch.__name__ = getattr(fn, "__name__", program)
+        dispatch.__wrapped__ = getattr(fn, "__wrapped__", fn)
+        dispatch._lockstep_inner = fn
+        return dispatch
+
+    def wrap_obj(obj, prefix: str) -> None:
+        for name, fn in sorted(vars(obj).items()):
+            if not name.endswith("_jit") or not callable(fn):
+                continue
+            if hasattr(fn, "_lockstep_inner"):
+                continue
+            setattr(obj, name, wrap(f"{prefix}.{name.strip('_')}", fn))
+
+    wrap_obj(trainer, kind)
+    if kind == "ppo":
+        # building the engine here (lazy property) keeps construction
+        # inside the simulated host identity, like production startup
+        wrap_obj(trainer.rollout_engine_obj, f"{kind}.engine")
+
+
+# ------------------------------- simulation ------------------------------- #
+
+@dataclass
+class LockstepResult:
+    """One trainer's N-host simulation: per-host dispatch logs."""
+
+    kind: str
+    hosts: int
+    mesh: Dict[str, int] = field(default_factory=dict)
+    logs: Dict[int, List[DispatchEvent]] = field(default_factory=dict)
+
+    def fingerprint(self) -> str:
+        return sequence_fingerprint(self.logs.get(0, []))
+
+    def dispatches(self) -> int:
+        return len(self.logs.get(0, []))
+
+    def program_counts(self) -> Dict[str, int]:
+        return dict(
+            sorted(Counter(e.program for e in self.logs.get(0, [])).items())
+        )
+
+    def to_row(self) -> Dict:
+        return {
+            "subject": self.kind,
+            "hosts": self.hosts,
+            "dispatches": self.dispatches(),
+            "fingerprint": self.fingerprint(),
+            "programs": self.program_counts(),
+        }
+
+
+def sequence_fingerprint(events: Sequence[DispatchEvent]) -> str:
+    """Stable hash of the canonical dispatch sequence (program,
+    signature, collective schedule per ordinal)."""
+    h = hashlib.sha256()
+    for e in events:
+        h.update(("|".join(e.key()) + "\n").encode())
+    return h.hexdigest()[:16]
+
+
+def _run_host(
+    kind: str,
+    mesh: Optional[Dict[str, int]],
+    hosts: int,
+    host: int,
+    steps: int,
+    trace_cache: Dict,
+    dump_dir: str,
+    plant: bool,
+) -> Tuple[List[DispatchEvent], Dict[str, int]]:
+    from trlx_tpu.analysis.compile_audit import CompileMonitor, drive_trainer
+
+    recorder = DispatchRecorder(kind, host, trace_cache)
+    captured: Dict[str, Any] = {}
+
+    def instrument(trainer) -> None:
+        _instrument_trainer(trainer, kind, recorder)
+        captured["trainer"] = trainer
+
+    with host_identity(host, hosts, recorder):
+        # health enabled: host 0 must build the monitor/flight recorder,
+        # hosts>0 must skip them — and neither arm may dispatch
+        overrides = {
+            "health": {"enabled": True, "dump_dir": dump_dir, "on_error": "warn"}
+        }
+        # the un-entered monitor installs no log handlers; engine 11
+        # audits dispatch order, engine 8 owns compile counts
+        _, _, mesh_shape = drive_trainer(
+            kind,
+            mesh,
+            monitor=CompileMonitor(),
+            steps=steps,
+            instrument=instrument,
+            train_overrides=overrides,
+        )
+        trainer = captured["trainer"]
+        # the health-observation path must be dispatch-free on every
+        # rank (host 0 has a monitor, the others None)
+        trainer.observe_health({"loss": 1.0, "kl": 0.1}, step=0, phase=0)
+        if plant:
+            import jax.numpy as jnp
+
+            from trlx_tpu.parallel.distributed import is_main_process
+
+            B = trainer.config.train.batch_size
+            Q = trainer.query_length
+            if is_main_process():
+                # deliberately planted rank-0-only dispatch: the
+                # --plant-divergence self-check that the simulator
+                # localizes exactly this hazard class
+                trainer.sample(
+                    jnp.ones((B, Q), jnp.int32), jnp.ones((B, Q), jnp.int32)
+                )
+    return recorder.events, mesh_shape
+
+
+def simulate_trainer(
+    kind: str,
+    hosts: int = 2,
+    mesh: Optional[Dict[str, int]] = None,
+    steps: int = 2,
+    plant: bool = False,
+) -> LockstepResult:
+    """Run ``kind``'s canonical loop as ``hosts`` simulated controllers
+    (sequential threads — determinism is part of the point) and return
+    the per-host dispatch logs."""
+    import tempfile
+
+    trace_cache: Dict = {}
+    result = LockstepResult(kind=kind, hosts=hosts)
+    errors: List[BaseException] = []
+    with tempfile.TemporaryDirectory(prefix="lockstep_health_") as dump_dir:
+        with simulated_hosts(hosts):
+            for host in range(hosts):
+
+                def run(host: int = host) -> None:
+                    try:
+                        log, mesh_shape = _run_host(
+                            kind, mesh, hosts, host, steps, trace_cache,
+                            dump_dir, plant,
+                        )
+                        result.logs[host] = log
+                        result.mesh.update(mesh_shape)
+                    except BaseException as e:  # surfaced below
+                        errors.append(e)
+
+                t = threading.Thread(
+                    target=run, name=f"lockstep-host-{host}", daemon=True
+                )
+                t.start()
+                t.join()
+                if errors:
+                    raise RuntimeError(
+                        f"lockstep simulation of {kind} failed on host "
+                        f"{host}/{hosts}"
+                    ) from errors[0]
+    return result
+
+
+# ------------------------------- divergence ------------------------------- #
+
+_AST_CACHE: Dict[str, Optional[ast.AST]] = {}
+
+
+def _parsed(fname: str) -> Optional[ast.AST]:
+    if fname not in _AST_CACHE:
+        try:
+            with open(fname, encoding="utf-8") as fh:
+                _AST_CACHE[fname] = ast.parse(fh.read(), filename=fname)
+        except (OSError, SyntaxError):
+            _AST_CACHE[fname] = None
+    return _AST_CACHE[fname]
+
+
+def _enclosing_branch(
+    fname: str, lineno: int, rank_only: bool
+) -> Optional[Tuple[int, str]]:
+    """(line, unparsed test) of the innermost ``if``/``while`` enclosing
+    ``lineno`` in ``fname`` — restricted to rank-gate tests when
+    ``rank_only`` (``is_main_process()`` / ``process_index()`` /
+    ``.is_main``)."""
+    from trlx_tpu.analysis.ast_lint import _is_rank_test
+
+    tree = _parsed(fname)
+    if tree is None:
+        return None
+    best: Optional[Tuple[int, str]] = None
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        if not (node.lineno <= lineno <= end):
+            continue
+        if rank_only and not _is_rank_test(node.test):
+            continue
+        if best is None or node.lineno > best[0]:
+            try:
+                best = (node.lineno, ast.unparse(node.test))
+            except Exception:
+                best = (node.lineno, "<unprintable test>")
+    return best
+
+
+def _guarding_branch(
+    event: DispatchEvent,
+) -> Optional[Tuple[str, int, str]]:
+    """The rank-gate branch a diverging dispatch sits under, searched
+    innermost-frame-out across the recorded stack; falls back to the
+    innermost enclosing branch of the call site."""
+    for fname, lineno in event.stack:
+        hit = _enclosing_branch(fname, lineno, rank_only=True)
+        if hit is not None:
+            return (fname, hit[0], hit[1])
+    for fname, lineno in event.stack:
+        hit = _enclosing_branch(fname, lineno, rank_only=False)
+        if hit is not None:
+            return (fname, hit[0], hit[1])
+    return None
+
+
+def _count_diff(
+    ref: Sequence[DispatchEvent], cur: Sequence[DispatchEvent]
+) -> str:
+    a = Counter(e.program for e in ref)
+    b = Counter(e.program for e in cur)
+    parts = []
+    for prog in sorted(set(a) | set(b)):
+        if a.get(prog, 0) != b.get(prog, 0):
+            parts.append(f"{prog}: {a.get(prog, 0)} vs {b.get(prog, 0)}")
+    return "; ".join(parts) or "per-program counts identical (order differs)"
+
+
+def _relpath(fname: str) -> str:
+    try:
+        rel = os.path.relpath(fname, _REPO_ROOT)
+    except ValueError:
+        return fname
+    return fname if rel.startswith("..") else rel
+
+
+def diff_host_logs(result: LockstepResult) -> List[Finding]:
+    """``lockstep-divergence`` findings: host 0 is the reference; every
+    other host's log must match event-for-event."""
+    rule = get_rule("lockstep-divergence")
+    findings: List[Finding] = []
+    ref = result.logs.get(0, [])
+    for host in sorted(result.logs):
+        if host == 0:
+            continue
+        cur = result.logs[host]
+        n = min(len(ref), len(cur))
+        div = next(
+            (i for i in range(n) if ref[i].key() != cur[i].key()), None
+        )
+        if div is None:
+            if len(ref) == len(cur):
+                continue
+            div = n
+        e0 = ref[div] if div < len(ref) else None
+        eh = cur[div] if div < len(cur) else None
+        guilty = e0 if e0 is not None else eh
+        guard = _guarding_branch(guilty)
+        site = guilty.site
+        where = (
+            f" at {_relpath(site[0])}:{site[1]}" if site is not None else ""
+        )
+        guard_txt = ""
+        file, line = site if site is not None else (None, None)
+        if guard is not None:
+            gf, gl, gtest = guard
+            guard_txt = (
+                f"; guarding branch: `{gtest}` at {_relpath(gf)}:{gl}"
+            )
+            file, line = gf, gl
+        d0 = e0.describe() if e0 is not None else (
+            "<absent — its loop already finished>"
+        )
+        dh = eh.describe() if eh is not None else (
+            "<absent — its loop already finished>"
+        )
+        findings.append(
+            Finding(
+                rule=rule.id,
+                message=(
+                    f"hosts diverge at dispatch ordinal {div} of the "
+                    f"{result.kind} canonical loop ({result.hosts} "
+                    f"simulated hosts): host 0 dispatched {d0}, host "
+                    f"{host} dispatched {dh}{where}{guard_txt}; per-host "
+                    f"state diff — {_count_diff(ref, cur)}. In a real "
+                    "multi-controller run the minority host(s) block in "
+                    "this program's first collective forever"
+                ),
+                severity=rule.severity,
+                file=file,
+                line=line,
+                subject=f"{result.kind}@host{host}",
+                engine="lockstep",
+            )
+        )
+    return findings
+
+
+# -------------------------------- budgets --------------------------------- #
+
+def make_lockstep_budgets(
+    results: Sequence[LockstepResult], hosts: int
+) -> Dict:
+    mesh: Dict[str, int] = {}
+    for r in results:
+        mesh = r.mesh or mesh
+    return {
+        "hosts": int(hosts),
+        "mesh": {k: int(v) for k, v in sorted(mesh.items())},
+        "trainers": {
+            r.kind: {
+                "fingerprint": r.fingerprint(),
+                "dispatches": r.dispatches(),
+                "programs": r.program_counts(),
+            }
+            for r in sorted(results, key=lambda r: r.kind)
+        },
+    }
+
+
+def check_lockstep_budgets(
+    results: Sequence[LockstepResult],
+    budgets: Dict,
+    budgets_path: Optional[str] = None,
+) -> List[Finding]:
+    """Gate host-0 dispatch fingerprints against the committed
+    ``lockstep_budgets`` contract."""
+    rule = get_rule("dispatch-sequence-drift")
+    where = os.path.basename(budgets_path or "budgets.json")
+    section = budgets.get("lockstep_budgets")
+    if section is None:
+        return [
+            Finding(
+                rule=rule.id,
+                message=(
+                    f"{where} has no lockstep_budgets section — lock the "
+                    "dispatch fingerprints with --lockstep "
+                    "--update-budgets and commit the diff"
+                ),
+                severity=rule.severity,
+                subject="lockstep_budgets",
+                engine="lockstep",
+            )
+        ]
+    findings: List[Finding] = []
+    mesh = {}
+    for r in results:
+        mesh = r.mesh or mesh
+    locked_mesh = section.get("mesh")
+    if locked_mesh is not None and mesh:
+        current = {k: int(v) for k, v in sorted(mesh.items())}
+        locked = {k: int(v) for k, v in sorted(locked_mesh.items())}
+        if locked != current:
+            return [
+                Finding(
+                    rule=rule.id,
+                    message=(
+                        f"lockstep budgets in {where} were locked for "
+                        f"mesh {locked_mesh} but the simulation ran on "
+                        f"{current} — fingerprints are not comparable; "
+                        "rerun on the locked mesh or --update-budgets"
+                    ),
+                    severity=rule.severity,
+                    subject="lockstep_budgets",
+                    engine="lockstep",
+                )
+            ]
+    trainers = section.get("trainers", {})
+    for r in results:
+        entry = trainers.get(r.kind)
+        if entry is None:
+            findings.append(
+                Finding(
+                    rule=rule.id,
+                    message=(
+                        f"no committed dispatch fingerprint for trainer "
+                        f"`{r.kind}` ({r.dispatches()} dispatches "
+                        "observed) — run --lockstep --update-budgets and "
+                        "review the lockfile diff"
+                    ),
+                    severity=rule.severity,
+                    subject=r.kind,
+                    engine="lockstep",
+                )
+            )
+            continue
+        if entry.get("fingerprint") != r.fingerprint():
+            locked_programs = entry.get("programs", {})
+            current_programs = r.program_counts()
+            parts = []
+            for prog in sorted(set(locked_programs) | set(current_programs)):
+                a = int(locked_programs.get(prog, 0))
+                b = int(current_programs.get(prog, 0))
+                if a != b:
+                    parts.append(f"{prog}: locked {a}, now {b}")
+            diff = "; ".join(parts) or (
+                "per-program counts unchanged — the order, a signature, "
+                "or a collective schedule moved"
+            )
+            findings.append(
+                Finding(
+                    rule=rule.id,
+                    message=(
+                        f"`{r.kind}` host-0 dispatch sequence drifted "
+                        f"from the committed contract (fingerprint "
+                        f"{entry.get('fingerprint')} -> {r.fingerprint()}"
+                        f"; {diff}) — every direction-1 component "
+                        "replays this schedule on N hosts; if the change "
+                        "is intended, relock with --lockstep "
+                        "--update-budgets and explain the diff"
+                    ),
+                    severity=rule.severity,
+                    subject=r.kind,
+                    engine="lockstep",
+                )
+            )
+    # entries for kinds this run did not simulate stay untouched — the
+    # compile-audit partial-run contract; stale entries for a simulated
+    # kind are impossible (one entry per kind), so no prune pass here
+    return findings
+
+
+# ----------------------------- orchestration ------------------------------ #
+
+def audit_lockstep(
+    kinds: Optional[Sequence[str]] = None,
+    hosts: int = 2,
+    mesh: Optional[Dict[str, int]] = None,
+    budgets_path: Optional[str] = None,
+    update: bool = False,
+    steps: int = 2,
+    plant: bool = False,
+) -> Tuple[Report, List[LockstepResult]]:
+    """The ``--lockstep`` entry point: simulate every trainer's canonical
+    loop on ``hosts`` controllers, diff the per-host dispatch logs, and
+    gate (or with ``update=True`` relock) host-0 fingerprints against the
+    ``lockstep_budgets`` section of ``analysis/budgets.json``."""
+    from trlx_tpu.analysis import harness
+    from trlx_tpu.analysis.resource_audit import (
+        default_budgets_path,
+        load_budgets,
+        write_budgets,
+    )
+
+    path = budgets_path or default_budgets_path()
+    report = Report()
+    results: List[LockstepResult] = []
+    for kind in kinds or harness.TRAINER_KINDS:
+        result = simulate_trainer(
+            kind, hosts=hosts, mesh=mesh, steps=steps, plant=plant
+        )
+        results.append(result)
+        report.covered.append(f"lockstep:{kind}@{hosts}hosts")
+
+    findings: List[Finding] = []
+    for result in results:
+        findings += diff_host_logs(result)
+
+    if update:
+        if findings:
+            # a diverging schedule is not a contract — refuse the relock
+            kept, suppressed = filter_suppressed(findings)
+            report.extend(kept)
+            report.suppressed += suppressed
+            return report, results
+        try:
+            budgets = load_budgets(path)
+        except (OSError, ValueError):
+            budgets = {}
+        partial = kinds is not None
+        section = make_lockstep_budgets(results, hosts)
+        old_section = budgets.get("lockstep_budgets") or {}
+        if partial and (
+            old_section.get("mesh") not in (None, section["mesh"])
+            or old_section.get("hosts") not in (None, section["hosts"])
+        ):
+            rule = get_rule("dispatch-sequence-drift")
+            report.extend([
+                Finding(
+                    rule=rule.id,
+                    message=(
+                        "refusing --update-budgets: the lockstep "
+                        f"lockfile is for mesh "
+                        f"{old_section.get('mesh')} / "
+                        f"{old_section.get('hosts')} hosts but this "
+                        f"--trainers subset ran on {section['mesh']} / "
+                        f"{section['hosts']} hosts — rerun without "
+                        "--trainers or on the locked configuration"
+                    ),
+                    severity=rule.severity,
+                    subject="lockstep_budgets",
+                    engine="lockstep",
+                )
+            ])
+            return report, results
+        if partial:
+            kept_entries = {
+                k: dict(e)
+                for k, e in old_section.get("trainers", {}).items()
+                if k not in {k2 for k2 in (kinds or ())}
+            }
+            kept_entries.update(section["trainers"])
+            section["trainers"] = {
+                k: kept_entries[k] for k in sorted(kept_entries)
+            }
+        budgets["lockstep_budgets"] = section
+        write_budgets(budgets, path)
+        return report, results
+
+    if not plant:
+        # --plant-divergence is a self-check of the simulator itself;
+        # gating its (deliberately divergent) run against the lockfile
+        # would bury the planted finding in drift noise
+        try:
+            budgets = load_budgets(path)
+        except (OSError, ValueError) as e:
+            rule = get_rule("dispatch-sequence-drift")
+            findings.append(
+                Finding(
+                    rule=rule.id,
+                    message=(
+                        f"cannot load budget contract {path}: {e} — "
+                        "generate it with --lockstep --update-budgets"
+                    ),
+                    severity=rule.severity,
+                    subject="lockstep_budgets",
+                    engine="lockstep",
+                )
+            )
+            budgets = {}
+        if budgets:
+            findings += check_lockstep_budgets(results, budgets, path)
+    kept, suppressed = filter_suppressed(findings)
+    report.extend(kept)
+    report.suppressed += suppressed
+    return report, results
+
+
+def format_lockstep_text(results: Sequence[LockstepResult]) -> str:
+    lines = [
+        f"{'trainer':10} {'hosts':>5} {'dispatches':>10}  fingerprint"
+    ]
+    for r in sorted(results, key=lambda r: r.kind):
+        lines.append(
+            f"{r.kind:10} {r.hosts:>5} {r.dispatches():>10}  "
+            f"{r.fingerprint()}"
+        )
+        for prog, n in r.program_counts().items():
+            lines.append(f"    {prog:40} ×{n}")
+    return "\n".join(lines)

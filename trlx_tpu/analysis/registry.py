@@ -24,6 +24,7 @@ ENGINE_DONATION = "donation"
 ENGINE_COMPILE = "compile"
 ENGINE_PRNG = "prng"
 ENGINE_PERF = "perf"
+ENGINE_LOCKSTEP = "lockstep"
 
 
 @dataclass(frozen=True)
@@ -339,6 +340,88 @@ register_rule(Rule(
     "*measured* time. The span lockfile turns wall-clock drift into a "
     "failing job — relock deliberately with --perf-audit "
     "--update-budgets, never by accident.",
+))
+
+# ------------------------ multi-controller lockstep ---------------------- #
+
+register_rule(Rule(
+    "lockstep-divergence",
+    ENGINE_LOCKSTEP,
+    "N simulated controller processes running a trainer's canonical host "
+    "loop dispatch the SAME jitted/collective-bearing programs in the "
+    "same order with the same arg signatures and collective schedules",
+    SEVERITY_ERROR,
+    "In multi-controller JAX every host drives its own Python loop; a "
+    "dispatch present on one host and absent (or different) on another "
+    "— a rank-0-gated jit call, a host-local branch — leaves the other "
+    "hosts blocked inside the program's first collective forever. The "
+    "simulator catches the deadlock before any multi-host hardware "
+    "exists, localized to the first diverging ordinal and call site.",
+))
+register_rule(Rule(
+    "dispatch-sequence-drift",
+    ENGINE_LOCKSTEP,
+    "a trainer's host-0 dispatch-sequence fingerprint over the canonical "
+    "loop matches the committed lockstep_budgets section of "
+    "analysis/budgets.json",
+    SEVERITY_ERROR,
+    "The dispatch schedule is the multi-host contract: reordering it, "
+    "adding a program, or changing a shape signature silently changes "
+    "what every direction-1 component (launcher, per-host restart, "
+    "cross-slice push) must replay identically. The lockfile turns "
+    "every schedule change into a reviewable diff — relock with "
+    "--lockstep --update-budgets, never by accident.",
+))
+
+# -------------------- host-concurrency lint (engine 12) ------------------- #
+
+register_rule(Rule(
+    "rank-gated-dispatch",
+    ENGINE_AST,
+    "no jitted or collective-bearing call is reachable only under a "
+    "process_index()/is_main_process rank gate in host-loop code",
+    SEVERITY_ERROR,
+    "A dispatch inside `if is_main_process():` runs a collective-bearing "
+    "program on host 0 only; the other hosts never enter it and the "
+    "collective blocks until the job is killed. Rank-gate host I/O "
+    "(logging, checkpoint writes), never device dispatch.",
+))
+register_rule(Rule(
+    "nondet-host-order",
+    ENGINE_AST,
+    "no iteration over set()/un-sorted os.listdir()/glob feeds a jitted "
+    "or collective-bearing call in host-loop code",
+    SEVERITY_ERROR,
+    "set/listdir/glob order is process-local: two hosts walking the "
+    "same logical collection dispatch the same programs in DIFFERENT "
+    "orders, and order is exactly what multi-controller lockstep "
+    "requires. Wrap the iterable in sorted(...).",
+))
+register_rule(Rule(
+    "host-time-in-dispatch",
+    ENGINE_AST,
+    "no wall-clock (time.time/monotonic/datetime.now) or host random "
+    "value steers a branch that guards a jitted or collective-bearing "
+    "call in host-loop code",
+    SEVERITY_WARNING,
+    "Host clocks and host RNG are per-process: a deadline or sampled "
+    "branch flips arms at different moments on different hosts, so one "
+    "host dispatches a program its peers skip — the next collective "
+    "hangs. Derive the decision from step counters or broadcast it "
+    "from rank 0 (distributed.broadcast_host_value).",
+))
+register_rule(Rule(
+    "unsynced-host-io",
+    ENGINE_AST,
+    "no value read from a per-host file (open/read/np.load/json.load) "
+    "feeds a jitted or collective-bearing call's arguments in host-loop "
+    "code",
+    SEVERITY_WARNING,
+    "Per-host reads of 'the same' file can observe different snapshots "
+    "(checkpoint-in-progress, node-local cache); a shape or value "
+    "difference re-hashes the jit cache key or mismatches the "
+    "collective's operands across hosts. Read on rank 0 and broadcast, "
+    "or route through the checkpoint layer's synchronized restore.",
 ))
 
 # ---------------------------- AST-lint rules ----------------------------- #
