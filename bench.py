@@ -662,6 +662,7 @@ def measure_throughput(config, n_phases=5):
         out["health"] = health_mon.health_summary()
     static_res = _static_resources(trainer)
     out.update(static_res)
+    out.update(_compiled_resources(trainer, static_res))
     out.update(
         _measured_memory(static_res.get("static_train_step_peak_hbm_gb"))
     )
@@ -795,6 +796,46 @@ def _static_resources(trainer):
         }
     except Exception as e:  # the measured numbers must still print
         return {"static_resource_error": f"{type(e).__name__}: {e}"}
+
+
+def _compiled_resources(trainer, static_res):
+    """Compiled ground truth next to the engine-6 statics
+    (docs/static_analysis.md, engine 13): the train step's actual
+    post-SPMD HLO collective payload and buffer-assignment peak from
+    the SAME jit instance the bench drives (the step is already
+    compiled by the measured window, so this re-lowers from cache).
+    The ``static_vs_compiled`` ratios are the live twin of the
+    hlo-memory-drift / collective-profile gates CI runs — a bench
+    round where compiled/static drifts while the lockfile is green
+    means the bench shape diverged from the audit shape, not XLA."""
+    try:
+        from trlx_tpu.analysis.hlo_audit import compiled_step_stats
+
+        kind = (
+            "ilql"
+            if trainer.__class__.__name__.startswith("ILQL")
+            else "ppo"
+        )
+        stats = compiled_step_stats(trainer, kind)
+        out = {
+            k: round(v, 3) for k, v in stats.items()
+        }
+        ratios = {}
+        static_mb = static_res.get("static_train_step_collective_mb")
+        if static_mb and "compiled_train_step_collective_mb" in stats:
+            ratios["collective_mb_compiled_over_static"] = round(
+                stats["compiled_train_step_collective_mb"] / static_mb, 3
+            )
+        static_gb = static_res.get("static_train_step_peak_hbm_gb")
+        if static_gb and "compiled_train_step_peak_hbm_gb" in stats:
+            ratios["peak_hbm_compiled_over_static"] = round(
+                stats["compiled_train_step_peak_hbm_gb"] / static_gb, 3
+            )
+        if ratios:
+            out["static_vs_compiled"] = ratios
+        return out
+    except Exception as e:  # the measured numbers must still print
+        return {"compiled_resource_error": f"{type(e).__name__}: {e}"}
 
 
 def _measured_memory(static_peak_gb):

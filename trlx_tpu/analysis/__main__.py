@@ -11,7 +11,8 @@ peak-HBM / collective / FLOP budgets per traced program), ``--compile-
 audit`` (runtime compile counting), ``--perf-audit`` (measured
 per-span wall-clock over the instrumented phase loop), and
 ``--lockstep`` (N simulated controller processes diffing per-host
-dispatch logs) — the latter four gated against the committed
+dispatch logs), and ``--hlo-audit`` (AOT-compiled post-SPMD HLO vs
+jaxpr intent) — the budgeted modes gated against the committed
 ``analysis/budgets.json`` with ``--update-budgets`` relocking each
 engine's own section. JSON output
 carries a top-level ``schema_version`` and deterministic ordering so CI
@@ -98,6 +99,32 @@ def main(argv=None) -> int:
         help="with --lockstep: plant one rank-0-only dispatch at the end "
         "of the loop — self-check that the simulator localizes exactly "
         "this hazard (budget gating is skipped; exit must be 1)",
+    )
+    parser.add_argument(
+        "--hlo-audit",
+        action="store_true",
+        help="instead of the rule engines: AOT-compile every traced "
+        "program with its real in_shardings, parse the optimized "
+        "post-SPMD HLO + buffer-assignment stats, diff the emitted "
+        "collectives/dtypes/peak against jaxpr intent and the "
+        "hlo_budgets section of analysis/budgets.json, and sweep the "
+        "known-miscompile registry (--update-budgets relocks)",
+    )
+    parser.add_argument(
+        "--plant-hazard",
+        action="store_true",
+        help="with --hlo-audit: compile a seeded eager concat of "
+        "committed-sharded arrays — self-check that the audit trips "
+        "both spmd-concat-hazard (at the planted line) and "
+        "lowering-collective-drift (on the minted replica-axis "
+        "all-reduce); budget gating is skipped; exit must be 1",
+    )
+    parser.add_argument(
+        "--no-mesh-matrix",
+        action="store_true",
+        help="with --hlo-audit: compile only the audit-mesh program set, "
+        "skipping the train-step compiles on the rest of the "
+        "collective-divergence mesh matrix (faster; less coverage)",
     )
     parser.add_argument(
         "--resources",
@@ -211,7 +238,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--update-budgets",
         action="store_true",
-        help="with --resources / --compile-audit / --perf-audit: "
+        help="with --resources / --compile-audit / --perf-audit / "
+        "--hlo-audit: "
         "regenerate that engine's section of the budget lockfile from "
         "the current run instead of checking against it (review the "
         "diff!); each engine's relock preserves the others' entries",
@@ -302,6 +330,37 @@ def main(argv=None) -> int:
         if args.trainers
         else None
     )
+
+    if args.hlo_audit or args.plant_hazard:
+        _force_cpu_platform()
+        from trlx_tpu.analysis.hlo_audit import audit_hlo, format_hlo_text
+
+        report, result = audit_hlo(
+            kinds=trainers,
+            mesh=mesh,
+            budgets_path=args.budgets,
+            update=args.update_budgets,
+            matrix=not args.no_mesh_matrix,
+            plant=args.plant_hazard,
+        )
+        if args.json:
+            report.resources = result.to_rows()
+            print(report.to_json())
+        else:
+            print(format_hlo_text(result))
+            if args.update_budgets and not report.findings:
+                print(
+                    "hlo budgets written — review and commit the "
+                    "lockfile diff"
+                )
+            if report.findings:
+                print(report.format_text())
+        if args.update_budgets:
+            # findings here mean the update was REFUSED (rule findings
+            # on the tree, or a cross-mesh partial relock) and nothing
+            # was written
+            return 1 if report.findings else 0
+        return report.exit_code(strict=args.strict)
 
     if args.lockstep:
         _force_cpu_platform()

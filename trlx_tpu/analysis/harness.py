@@ -200,10 +200,20 @@ class TracedProgram:
     # elements, from the trainer's declared in_shardings) — the resource
     # auditor divides each input's bytes by this to get per-device HBM
     input_divisors: Optional[List[int]] = None
+    # per-flat-input tuple of mesh-split dimensions (same order) — the
+    # HLO auditor's spmd-concat-hazard walk only treats a concatenate as
+    # the PR-2 shape when the concat dimension is one the mesh splits
+    input_sharded_dims: Optional[List[Tuple[int, ...]]] = None
     # (file, line) of the traced callable's def — findings with no eqn to
     # anchor to (donation-ignored, alias-escape) attach here so inline
     # `# tpu-lint: disable=` directives still work
     def_site: Optional[Tuple[str, int]] = None
+    # the jitted callable itself plus the abstract args it was traced
+    # with — the HLO auditor AOT-lowers `jit_fn.lower(*example_args)`
+    # to get the optimized post-SPMD module XLA actually emits (the
+    # jaxpr above is intent; this is ground truth)
+    jit_fn: Any = None
+    example_args: Any = None
 
 
 def callable_def_site(fn) -> Optional[Tuple[str, int]]:
@@ -215,23 +225,25 @@ def callable_def_site(fn) -> Optional[Tuple[str, int]]:
     return code.co_filename, code.co_firstlineno
 
 
-def flat_sharding_divisors(arg_trees, sharding_trees) -> List[int]:
-    """Per-flat-leaf sharding divisor, in make_jaxpr flattening order.
+def _flat_sharding_info(arg_trees, sharding_trees) -> List[Tuple[int, Tuple[int, ...]]]:
+    """Per-flat-leaf ``(divisor, sharded_dims)`` in make_jaxpr order.
 
     ``sharding_trees`` mirrors ``arg_trees``; an entry of ``None`` (or a
-    leaf without ``shard_shape``) means replicated -> divisor 1. Each
-    divisor is ``total elements / per-device shard elements`` of the
-    matching :class:`~jax.sharding.NamedSharding`.
+    leaf without ``shard_shape``) means replicated -> ``(1, ())``. The
+    divisor is ``total elements / per-device shard elements``; the dims
+    are the axes along which the per-device shard is strictly smaller
+    than the global shape (i.e. the dimensions the mesh actually
+    splits).
     """
     import math
 
     import jax
 
-    divisors: List[int] = []
+    info: List[Tuple[int, Tuple[int, ...]]] = []
     for args, shardings in zip(arg_trees, sharding_trees):
         leaves = jax.tree_util.tree_leaves(args)
         if shardings is None:
-            divisors += [1] * len(leaves)
+            info += [(1, ())] * len(leaves)
             continue
         sh_leaves = jax.tree_util.tree_leaves(
             shardings, is_leaf=lambda x: hasattr(x, "shard_shape")
@@ -242,17 +254,33 @@ def flat_sharding_divisors(arg_trees, sharding_trees) -> List[int]:
         for leaf, sh in zip(leaves, sh_leaves):
             shape = tuple(getattr(leaf, "shape", ()))
             if not hasattr(sh, "shard_shape") or not shape:
-                divisors.append(1)
+                info.append((1, ()))
                 continue
             try:
                 shard = sh.shard_shape(shape)
                 total = math.prod(shape)
                 per_dev = math.prod(shard)
-                divisors.append(max(1, total // max(1, per_dev)))
+                dims = tuple(
+                    d for d, (g, s) in enumerate(zip(shape, shard)) if s < g
+                )
+                info.append((max(1, total // max(1, per_dev)), dims))
             except Exception:
-                divisors.append(1)
-        divisors += [1] * (len(leaves) - min(len(leaves), len(sh_leaves)))
-    return divisors
+                info.append((1, ()))
+        info += [(1, ())] * (len(leaves) - min(len(leaves), len(sh_leaves)))
+    return info
+
+
+def flat_sharding_divisors(arg_trees, sharding_trees) -> List[int]:
+    """Per-flat-leaf sharding divisor (total / per-device elements)."""
+    return [d for d, _ in _flat_sharding_info(arg_trees, sharding_trees)]
+
+
+def flat_sharded_dims(arg_trees, sharding_trees) -> List[Tuple[int, ...]]:
+    """Per-flat-leaf tuple of mesh-split dimensions — lets the HLO
+    auditor's concat-hazard walk tell a concat *along* a sharded axis
+    (the PR-2 miscompile shape) from a benign local concat along a
+    replicated one."""
+    return [dims for _, dims in _flat_sharding_info(arg_trees, sharding_trees)]
 
 
 def flat_input_paths(*trees, prefixes: Optional[Sequence[str]] = None) -> List[str]:
@@ -331,6 +359,30 @@ def trace_train_step(kind: str, mesh: Optional[Dict[str, int]] = None):
     state_sds = _sds(trainer.state)
     mb = _ilql_minibatch_sds(trainer) if kind == "ilql" else _ppo_minibatch_sds(trainer)
     return jax.make_jaxpr(trainer._train_step_jit)(state_sds, mb)
+
+
+def trace_train_step_program(
+    kind: str, mesh: Optional[Dict[str, int]] = None
+) -> TracedProgram:
+    """Like :func:`trace_train_step` but packaged as a
+    :class:`TracedProgram` with the jit handle attached — the HLO
+    auditor compiles the step on each mesh of the collective-divergence
+    matrix (the PR-2 replica-sum only mis-lowered on meshes with a
+    spare axis, so single-mesh compiled coverage is not enough)."""
+    import jax
+
+    trainer = build_trainer(kind, mesh)
+    state_sds = _sds(trainer.state)
+    mb = _ilql_minibatch_sds(trainer) if kind == "ilql" else _ppo_minibatch_sds(trainer)
+    return TracedProgram(
+        subject=f"{kind}.train_step",
+        closed_jaxpr=jax.make_jaxpr(trainer._train_step_jit)(state_sds, mb),
+        mesh_axes=set(trainer.mesh.axis_names),
+        mesh_shape={k: int(v) for k, v in trainer.mesh.shape.items()},
+        def_site=callable_def_site(trainer._train_step_jit),
+        jit_fn=trainer._train_step_jit,
+        example_args=(state_sds, mb),
+    )
 
 
 def concrete_minibatch(trainer, kind: str, seed: int = 0):
@@ -414,7 +466,12 @@ def trace_trainer(
             input_divisors=flat_sharding_divisors(
                 (state_sds, mb), (trainer.state_shardings, batch_sh)
             ),
+            input_sharded_dims=flat_sharded_dims(
+                (state_sds, mb), (trainer.state_shardings, batch_sh)
+            ),
             def_site=callable_def_site(trainer._train_step_jit),
+            jit_fn=trainer._train_step_jit,
+            example_args=(state_sds, mb),
         )
     ]
 
@@ -465,7 +522,12 @@ def trace_trainer(
             input_divisors=flat_sharding_divisors(
                 rollout_args, rollout_shardings
             ),
+            input_sharded_dims=flat_sharded_dims(
+                rollout_args, rollout_shardings
+            ),
             def_site=callable_def_site(trainer._sample_jit),
+            jit_fn=trainer._sample_jit,
+            example_args=rollout_args,
         )
     )
 
@@ -500,7 +562,16 @@ def trace_trainer(
                         stacked_batch_sharding(trainer.mesh),
                     ),
                 ),
+                input_sharded_dims=flat_sharded_dims(
+                    (state_sds, stacked),
+                    (
+                        trainer.state_shardings,
+                        stacked_batch_sharding(trainer.mesh),
+                    ),
+                ),
                 def_site=callable_def_site(trainer._train_phase_jit),
+                jit_fn=trainer._train_phase_jit,
+                example_args=(state_sds, stacked),
             )
         )
         # the streamed phase's behavior-policy snapshot (compute-dtype
@@ -522,7 +593,12 @@ def trace_trainer(
                 input_divisors=flat_sharding_divisors(
                     (params_sds,), (trainer.state_shardings.params,)
                 ),
+                input_sharded_dims=flat_sharded_dims(
+                    (params_sds,), (trainer.state_shardings.params,)
+                ),
                 def_site=callable_def_site(trainer._behavior_snapshot_jit),
+                jit_fn=trainer._behavior_snapshot_jit,
+                example_args=(params_sds,),
             )
         )
     if kind == "ppo":
@@ -588,7 +664,12 @@ def _trace_async_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram]
             input_divisors=flat_sharding_divisors(
                 (params_sds,), (trainer.state_shardings.params,)
             ),
+            input_sharded_dims=flat_sharded_dims(
+                (params_sds,), (trainer.state_shardings.params,)
+            ),
             def_site=callable_def_site(trainer._weight_push_jit),
+            jit_fn=trainer._weight_push_jit,
+            example_args=(params_sds,),
         ),
         TracedProgram(
             subject=f"{kind}.versioned_land",
@@ -606,7 +687,12 @@ def _trace_async_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram]
             input_divisors=flat_sharding_divisors(
                 land_args, (batch_sh, batch_sh, None)
             ),
+            input_sharded_dims=flat_sharded_dims(
+                land_args, (batch_sh, batch_sh, None)
+            ),
             def_site=callable_def_site(ppo_buffer._land_rows_jit),
+            jit_fn=ppo_buffer._land_rows_jit,
+            example_args=land_args,
         ),
     ]
 
@@ -664,7 +750,12 @@ def _trace_engine_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram
             input_divisors=flat_sharding_divisors(
                 prefill_args, prefill_shardings
             ),
+            input_sharded_dims=flat_sharded_dims(
+                prefill_args, prefill_shardings
+            ),
             def_site=callable_def_site(engine.prefill_jit),
+            jit_fn=engine.prefill_jit,
+            example_args=prefill_args,
         ),
         TracedProgram(
             subject=f"{kind}.engine_decode_step",
@@ -679,7 +770,12 @@ def _trace_engine_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram
             input_divisors=flat_sharding_divisors(
                 decode_args, (params_sh, state_sh)
             ),
+            input_sharded_dims=flat_sharded_dims(
+                decode_args, (params_sh, state_sh)
+            ),
             def_site=callable_def_site(engine.decode_step_jit),
+            jit_fn=engine.decode_step_jit,
+            example_args=decode_args,
         ),
         TracedProgram(
             subject=f"{kind}.engine_refill",
@@ -693,7 +789,12 @@ def _trace_engine_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram
             input_divisors=flat_sharding_divisors(
                 refill_args, (state_sh, None)
             ),
+            input_sharded_dims=flat_sharded_dims(
+                refill_args, (state_sh, None)
+            ),
             def_site=callable_def_site(engine.refill_jit),
+            jit_fn=engine.refill_jit,
+            example_args=refill_args,
         ),
     ] + _trace_chunked_prefill_programs(
         trainer, engine, kind, mesh_shape, shared=False
@@ -800,7 +901,12 @@ def _trace_chunked_prefill_programs(
                 input_divisors=flat_sharding_divisors(
                     chunks_args, chunks_shardings
                 ),
+                input_sharded_dims=flat_sharded_dims(
+                    chunks_args, chunks_shardings
+                ),
                 def_site=callable_def_site(engine.prefill_chunks_jit),
+                jit_fn=engine.prefill_chunks_jit,
+                example_args=chunks_args,
             )
         )
     out.append(
@@ -817,7 +923,12 @@ def _trace_chunked_prefill_programs(
             input_divisors=flat_sharding_divisors(
                 finish_args, finish_shardings
             ),
+            input_sharded_dims=flat_sharded_dims(
+                finish_args, finish_shardings
+            ),
             def_site=callable_def_site(engine.prefill_finish_jit),
+            jit_fn=engine.prefill_finish_jit,
+            example_args=finish_args,
         )
     )
     return out
@@ -905,7 +1016,12 @@ def _trace_serving_engine_programs(
             input_divisors=flat_sharding_divisors(
                 prefill_args, prefill_shardings
             ),
+            input_sharded_dims=flat_sharded_dims(
+                prefill_args, prefill_shardings
+            ),
             def_site=callable_def_site(serving_engine.prefill_jit),
+            jit_fn=serving_engine.prefill_jit,
+            example_args=prefill_args,
         ),
         TracedProgram(
             subject=f"{kind}.engine_decode_step_stream",
@@ -920,7 +1036,12 @@ def _trace_serving_engine_programs(
             input_divisors=flat_sharding_divisors(
                 decode_args, (params_sh, state_sh)
             ),
+            input_sharded_dims=flat_sharded_dims(
+                decode_args, (params_sh, state_sh)
+            ),
             def_site=callable_def_site(serving_engine.decode_step_jit),
+            jit_fn=serving_engine.decode_step_jit,
+            example_args=decode_args,
         ),
         TracedProgram(
             subject=f"{kind}.engine_refill_shared",
@@ -936,7 +1057,12 @@ def _trace_serving_engine_programs(
             input_divisors=flat_sharding_divisors(
                 refill_args, (state_sh, None)
             ),
+            input_sharded_dims=flat_sharded_dims(
+                refill_args, (state_sh, None)
+            ),
             def_site=callable_def_site(serving_engine.refill_jit),
+            jit_fn=serving_engine.refill_jit,
+            example_args=refill_args,
         ),
         TracedProgram(
             subject=f"{kind}.engine_release",
@@ -952,7 +1078,12 @@ def _trace_serving_engine_programs(
             input_divisors=flat_sharding_divisors(
                 release_args, (state_sh, None)
             ),
+            input_sharded_dims=flat_sharded_dims(
+                release_args, (state_sh, None)
+            ),
             def_site=callable_def_site(serving_engine.release_jit),
+            jit_fn=serving_engine.release_jit,
+            example_args=release_args,
         ),
     ] + _trace_chunked_prefill_programs(
         trainer, serving_engine, kind, mesh_shape, shared=True

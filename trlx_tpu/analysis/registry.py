@@ -25,6 +25,7 @@ ENGINE_COMPILE = "compile"
 ENGINE_PRNG = "prng"
 ENGINE_PERF = "perf"
 ENGINE_LOCKSTEP = "lockstep"
+ENGINE_HLO = "hlo"
 
 
 @dataclass(frozen=True)
@@ -371,6 +372,61 @@ register_rule(Rule(
     "cross-slice push) must replay identically. The lockfile turns "
     "every schedule change into a reviewable diff — relock with "
     "--lockstep --update-budgets, never by accident.",
+))
+
+# -------------------- compiled-HLO audit (engine 13) --------------------- #
+
+register_rule(Rule(
+    "lowering-collective-drift",
+    ENGINE_HLO,
+    "the collectives XLA actually emitted for a program (optimized "
+    "post-SPMD HLO) match jaxpr intent and the committed hlo_budgets "
+    "profile: no concat-minted replica-axis all-reduce, no dropped "
+    "explicit collective, no inserted/dropped/re-axised profile key",
+    SEVERITY_ERROR,
+    "The jaxpr is intent; the compiled module is what the TPU runs. "
+    "Both of this repo's worst correctness bugs were XLA's SPMD "
+    "partitioner rewriting collectives below the jaxpr (the PR-2 "
+    "sharded-concat replica-SUM, the quarantined pp cached-decode "
+    "stack) — drift at this layer is invisible to every jaxpr-level "
+    "engine and NaNs the run at scale.",
+))
+register_rule(Rule(
+    "hlo-dtype-upcast",
+    ENGINE_HLO,
+    "no non-scalar f32 tensor minted from bf16 inputs by the optimized "
+    "module outside the softmax/layernorm/loss accumulation allowlist",
+    SEVERITY_WARNING,
+    "XLA may legally widen compute during optimization; an activation-"
+    "rank f32 tensor the source never wrote doubles HBM traffic and "
+    "defeats the bf16 compute contract (PAPER.md: policy in bfloat16) "
+    "— and the jaxpr-level precision-leak rule cannot see compiler-"
+    "minted converts.",
+))
+register_rule(Rule(
+    "hlo-memory-drift",
+    ENGINE_HLO,
+    "each program's compiled buffer-assignment peak (temp + args + "
+    "outputs - donation aliasing) stays within tolerance of the "
+    "committed hlo_budgets entry",
+    SEVERITY_ERROR,
+    "Engine 7's static peak is a model; XLA's buffer assignment is the "
+    "allocation the device makes. A fusion or layout change can "
+    "regress real live memory while the static number holds — the "
+    "lockfile turns that silent regression into a reviewable diff.",
+))
+register_rule(Rule(
+    "spmd-concat-hazard",
+    ENGINE_HLO,
+    "no eager multi-operand concatenate of committed-sharded operands "
+    "on a multi-device mesh outside the blessed spmd_stack/concat_cols "
+    "helpers",
+    SEVERITY_ERROR,
+    "XLA's SPMD partitioner has twice mis-lowered exactly this shape "
+    "into a replica-axis SUM (PR 2; the quarantined pp cached-decode "
+    "stack). The dynamic_update_slice spelling in the blessed helpers "
+    "is the sanctioned route — this rule automates the ROADMAP 'watch "
+    "for new eager concat/stack' human obligation.",
 ))
 
 # -------------------- host-concurrency lint (engine 12) ------------------- #
