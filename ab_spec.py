@@ -1,36 +1,30 @@
-"""A/B: multi-token / speculative decode on the pretrained stand-in
-(VERDICT r4 #3 — the last structural collect-phase lever).
+"""A/B: trie-drafted speculative decoding in the continuous engine,
+sharing off and on.
 
-Decode is op-LATENCY-bound on this link (~1.5 ms/step at the bench shape
-vs a ~0.5 ms traffic floor; ROADMAP "Round-4 perf findings" #3), which is
-exactly the regime where speculative decoding pays: k cheap draft steps +
-ONE full-model verify pass replace k sequential full steps, and the verify
-pass (k tokens at once) costs about the same latency as a single-token
-step.
+Supersedes the old stage-1 projection (acceptance probe + break-even
+table): the drafted ``verify_step`` is now implemented
+(docs/inference.md "Speculative decoding"), so this measures the real
+thing. Four variants run the SAME serving-style pump loop:
+{spec off, spec on} x {sharing off, sharing on}. Spec-off decodes one
+token per jitted step; spec-on proposes up to ``max_draft`` host-drafted
+tokens per slot (n-gram self-lookup; with sharing on, the shared-prefix
+trie's ready chains as a global corpus) and verifies them in one batched
+pass — accepted tokens are bitwise the tokens the one-token loop would
+have sampled (the per-row RNG contract), which the warming round pins.
 
-Stage 1 (this file, always runs) — the math that decides viability without
-building the sampler:
+Methodology per the repo's measurement discipline: variants interleave
+across rounds (wall-clock swings with machine load — A/B by alternation,
+never against recorded numbers), and the CPU tier auto-shrinks the
+model. The CPU record verifies bitwise parity + a nonzero accept-rate
+with tokens-per-verify > 1; the headline wall delta is a TPU
+measurement (direction 5a — this script self-records it on first
+hardware run). Low temperature makes the workload draftable: near-greedy
+decode on cyclic prompts falls into loops the n-gram drafter locks onto,
+which is the regime speculation targets (templated/repetitive spans).
 
-- **Acceptance probe.** For speculative sampling the per-position
-  acceptance probability is EXACTLY ``sum_x min(p(x), q(x))`` (p = target,
-  q = draft). We sample real rollouts from the locally-pretrained stand-in
-  checkpoint (`ckpts/standin_gpt2`, real output distribution — the r4
-  "random-init can't exercise acceptance" excuse does not apply here),
-  then evaluate that sum at every response position for the natural
-  self-draft: a 1-layer early exit reusing the target's own
-  wte/wpe/h_0/ln_f/head (no separate draft training, no extra memory).
-- **Latency probe.** Measured per-step latency of the draft (1-layer) vs
-  target (2-layer) samplers at the reward-tier shape, chained inside one
-  jit (tunnel methodology).
-- **Projection.** Expected accepted tokens per round for k drafts is
-  ``(1 - a^(k+1)) / (1 - a)`` (a = acceptance); round cost is
-  ``k * t_draft + t_verify``. Speedup = tokens/round / (cost_round /
-  t_target). Printed for k = 1..6 with the argmax.
-
-Stage 2 (only if the projection clears 1.1x): implement the compiled
-speculative sampler and measure end-to-end. If the projection is below
-threshold, this file IS the measured-negative artifact — the methodology
-and numbers say why the lever stays unpulled.
+Self-recording: updates ``AB_SPEC.json`` (latest record per metric +
+device kind, ``utils/ab_record.py``) and appends a run-ledger manifest
+(``telemetry/run_ledger.py``).
 """
 
 import json
@@ -39,211 +33,281 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(
-    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples")
-)
+os.environ.setdefault("WANDB_DISABLED", "1")
 
-K_RANGE = range(1, 7)
+import numpy as np
+
+MAX_DRAFT = 4
 
 
-def main():
-    os.environ.setdefault("WANDB_DISABLED", "1")
+def build_trainer():
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
-    from pretrained_standin import (
-        causal_rl_config, ensure_gpt2_checkpoint, make_prompts,
-    )
     from trlx_tpu.data.configs import TRLConfig
     from trlx_tpu.utils.loading import get_trainer
 
-    ckpt = ensure_gpt2_checkpoint()
-    config = TRLConfig.from_dict(causal_rl_config(ckpt))
-    trainer = get_trainer(config.train.trainer)(
-        config, reward_fn=lambda **kw: [0.0]
+    on_cpu = jax.default_backend() == "cpu"
+    arch = (
+        {"vocab_size": 512, "n_positions": 128, "n_embd": 64,
+         "n_layer": 2, "n_head": 2}
+        if on_cpu
+        else {"vocab_size": 50257, "n_positions": 1024, "n_embd": 768,
+              "n_layer": 12, "n_head": 12}
     )
-    gen = trainer.gen_config
-    B, Q = 64, 8
-    R = gen.max_new_tokens
-
-    rng = np.random.default_rng(0)
-    prompts = make_prompts(rng, B, Q)
-    prompt_ids = jnp.asarray(
-        [p + [0] * (Q - len(p)) for p in prompts], jnp.int32
-    )[:, :Q]
-    prompt_mask = jnp.ones((B, Q), jnp.int32)
-
-    out = trainer.sample(prompt_ids, prompt_mask)
-    full_ids = out.tokens  # [B, Q + R_eff] (R_eff = bound decode budget)
-    R = full_ids.shape[1] - Q
-    resp_mask = np.asarray(out.response_mask, bool)
-    if resp_mask.shape[1] == full_ids.shape[1]:
-        resp_mask = resp_mask[:, Q:]  # align with response positions
-
-    backbone_params = trainer.state.params["transformer"]
-    arch = trainer.model_config
-
-    # target probs at response-predicting positions
-    def probs_of(model, params):
-        o = model.apply(
-            {"params": params}, full_ids,
-            attention_mask=jnp.ones_like(full_ids),
-        )
-        logits = o["logits"][:, Q - 1 : -1].astype(jnp.float32)
-        if gen.temperature and gen.temperature != 1.0:
-            logits = logits / gen.temperature
-        return jax.nn.softmax(logits, axis=-1)
-
-    from trlx_tpu.models.registry import get_model_family
-
-    family = get_model_family("gpt2")
-    target_probs = jax.jit(
-        lambda p: probs_of(trainer.backbone, p)
-    )(backbone_params)
-
-    # self-draft: 1-layer early exit reusing wte/wpe/h_0/ln_f (+tied head)
-    draft_arch = family.config_cls.from_dict(
-        {**{k: getattr(arch, k) for k in (
-            "vocab_size", "n_positions", "n_embd", "n_head",
-        )}, "n_layer": 1, "dtype": arch.dtype}
+    Q = 32 if on_cpu else 64
+    R = 16 if on_cpu else 48
+    rollout = (
+        {"engine": "continuous", "slots": 16, "admit_width": 8,
+         "harvest_width": 8, "block_size": 8}
+        if on_cpu
+        else {"engine": "continuous", "admit_width": 32,
+              "harvest_width": 32, "block_size": 16}
     )
-    draft_model = family.backbone_cls(draft_arch)
-    draft_params = {
-        k: backbone_params[k] for k in ("wte", "wpe", "h_0", "ln_f")
-    }
-    draft_probs = jax.jit(
-        lambda p: probs_of(draft_model, p)
-    )(draft_params)
-
-    accept = jnp.sum(
-        jnp.minimum(target_probs, draft_probs), axis=-1
-    )  # [B, R]
-    a = float(
-        (np.asarray(accept) * resp_mask).sum() / max(resp_mask.sum(), 1)
-    )
-
-    # --- latency probe: chained decode steps inside one jit ------------
-    from trlx_tpu.models.gpt2 import init_cache
-
-    def step_latency(model, params, b, q, r):
-        C = q + r
-        cache = init_cache(model.config, b, C)
-        ids0 = jnp.zeros((b, 1), jnp.int32)
-
-        # params are an ARGUMENT, not a closure — closed-over arrays
-        # serialize into the compile request and the tunnel rejects the
-        # 124M-param program body (HTTP 413)
-        def run(p, ids, cache):
-            def body(carry, _):
-                ids, cache = carry
-                o = model.apply(
-                    {"params": p}, ids,
-                    attention_mask=jnp.ones((b, C), jnp.int32),
-                    cache=cache, cache_index=jnp.int32(q),
-                )
-                nxt = jnp.argmax(
-                    o["logits"][:, -1], axis=-1
-                )[:, None].astype(jnp.int32)
-                return (nxt, o["cache"]), None
-
-            (ids, cache), _ = jax.lax.scan(
-                body, (ids, cache), None, length=50
-            )
-            return ids
-
-        fn = jax.jit(run)
-        out0 = fn(params, ids0, cache)
-        jax.block_until_ready(out0)
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.time()
-            jax.block_until_ready(fn(params, ids0, cache))
-            best = min(best, time.time() - t0)
-        return best / 50
-
-    t_target = step_latency(trainer.backbone, backbone_params, B, Q, R)
-    t_draft = step_latency(draft_model, draft_params, B, Q, R)
-    # verify pass = one full-model forward over k+1 tokens with cache —
-    # latency-bound, so approximate with the measured single-step target
-    # latency (k tokens widen an already tiny matmul)
-    t_verify = t_target
-
-    proj = {}
-    for k in K_RANGE:
-        tokens = (1 - a ** (k + 1)) / (1 - a) if a < 1 else k + 1
-        cost = k * t_draft + t_verify
-        proj[k] = tokens / (cost / t_target)
-    best_k = max(proj, key=proj.get)
-
-    result = {
-        "acceptance_rate": round(a, 4),
-        "t_target_ms": round(t_target * 1e3, 3),
-        "t_draft_ms": round(t_draft * 1e3, 3),
-        "projected_speedup_by_k": {k: round(v, 3) for k, v in proj.items()},
-        "best_k": best_k,
-        "best_projected_speedup": round(proj[best_k], 3),
-        "verdict": (
-            "IMPLEMENT stage 2" if proj[best_k] > 1.1 else
-            "NEGATIVE: projection below 1.1x — lever stays unpulled"
-        ),
-    }
-
-    # --- the other half: latency ratio at the BENCH workload shape.
-    # Acceptance there is unmeasurable without a real checkpoint
-    # (random-init distributions are meaningless), but the draft/target
-    # latency ratio rho IS measurable, and with it the BREAK-EVEN
-    # acceptance curve: speculation wins iff
-    # (1 - a^(k+1)) / (1 - a) > k*rho + 1.
-    from bench import _workload_config
-    from trlx_tpu.models.registry import get_model_family as _fam
-
-    # the EXACT bench workload arch (single source of truth) + a 2-layer
-    # shared-weight draft of it
-    bench_arch_dict = dict(
-        _workload_config(0, 2).model.model_arch, dtype="bfloat16"
-    )
-    bench_arch = _fam("gpt2").config_cls.from_dict(bench_arch_dict)
-    bench_model = _fam("gpt2").backbone_cls(bench_arch)
-    draft2_arch = _fam("gpt2").config_cls.from_dict(
-        dict(bench_arch_dict, n_layer=2)
-    )
-    draft2_model = _fam("gpt2").backbone_cls(draft2_arch)
-    rngk = jax.random.PRNGKey(0)
-    dummy = jnp.ones((2, 4), jnp.int32)
-    bench_params = bench_model.init(
-        rngk, dummy, attention_mask=jnp.ones_like(dummy)
-    )["params"]
-    draft2_params = {
-        k: bench_params[k] for k in ("wte", "wpe", "h_0", "h_1", "ln_f")
-    }
-
-    t_bench_target = step_latency(bench_model, bench_params, 128, 64, 48)
-    t_bench_draft = step_latency(draft2_model, draft2_params, 128, 64, 48)
-    rho = t_bench_draft / t_bench_target
-
-    def break_even_acceptance(k, rho):
-        lo, hi = 0.0, 1.0
-        for _ in range(40):
-            mid = (lo + hi) / 2
-            tokens = (k + 1) if mid >= 1 else (1 - mid ** (k + 1)) / (1 - mid)
-            if tokens > k * rho + 1:
-                hi = mid
-            else:
-                lo = mid
-        return hi
-
-    result.update(
+    config = TRLConfig.from_dict(
         {
-            "bench_shape_t_target_ms": round(t_bench_target * 1e3, 3),
-            "bench_shape_t_draft2_ms": round(t_bench_draft * 1e3, 3),
-            "bench_shape_rho": round(rho, 3),
-            "bench_shape_break_even_acceptance_by_k": {
-                k: round(break_even_acceptance(k, rho), 3) for k in K_RANGE
+            "model": {"model_type": "gpt2", "model_arch": arch},
+            "train": {
+                "seq_length": Q, "batch_size": 16, "epochs": 1,
+                "total_steps": 10000, "eval_interval": 100000,
+                "checkpoint_interval": 1000000,
+                "mesh": {"dp": -1, "fsdp": 1, "tp": 1},
+                "dtype": "bfloat16",
+                "rollout": rollout,
+            },
+            "method": {
+                "name": "PPOConfig", "num_rollouts": 128,
+                "chunk_size": 128, "ppo_epochs": 4,
+                "gen_kwargs": {
+                    "max_new_tokens": R,
+                    "min_new_tokens": R,
+                    "top_k": 0,
+                    "do_sample": True,
+                    # near-greedy: random-init decode loops, so the
+                    # drafter has something to accept (see docstring)
+                    "temperature": 0.05,
+                    "per_row_rng": True,
+                    "eos_token_id": 511 if on_cpu else 50256,
+                    "pad_token_id": 511 if on_cpu else 50256,
+                },
             },
         }
     )
-    print(json.dumps(result))
+    return get_trainer(config.train.trainer)(
+        config, reward_fn=lambda **kw: [0.0]
+    )
+
+
+def build_engines(trainer, spec_draft, pool_blocks):
+    base = trainer.rollout_engine_obj
+    return type(base)(
+        apply_fn=base._apply_fn,
+        init_cache_fn=base._init_cache_fn,
+        gen_config=base.gen_config,
+        query_length=base.Q,
+        vocab_size=base.vocab_size,
+        num_slots=base.num_slots,
+        admit_width=base.admit_width,
+        harvest_width=base.harvest_width,
+        block_size=base.block_size,
+        mesh=base.mesh,
+        param_shardings=base._param_shardings,
+        cache_sharding=base._cache_sharding,
+        with_values=base.with_values,
+        prefix_pool_blocks=pool_blocks,
+        spec_max_draft=spec_draft,
+    )
+
+
+def make_prompts(rng, n, Q, shared_prefix):
+    """[n, Q] ids/mask: cyclic two-token motifs (every suffix recurs, so
+    the n-gram drafter has a match the moment decode starts looping).
+    ``shared_prefix`` overwrites the leading half so the trie publishes
+    common chains (the sharing-on workload); prompt identity between a
+    spec/no-spec pair comes from the shared seed."""
+    ids = np.zeros((n, Q), np.int32)
+    for i in range(n):
+        a = 3 + int(rng.integers(0, 4))
+        b = 9 + int(rng.integers(0, 4))
+        ids[i] = np.tile([a, b], (Q + 1) // 2)[:Q]
+    mask = np.ones((n, Q), np.int32)
+    if shared_prefix is not None:
+        ids[:, : len(shared_prefix)] = shared_prefix
+    return ids, mask
+
+
+def serve_rows(engine, ids, mask, pool=None):
+    """Serving-style pump loop: plan-just-in-time admission in
+    admit_width waves, pump to completion. Returns {row: tokens} host
+    arrays. Pool refcounts are deliberately not released (the run ends;
+    the pool is sized to never fill)."""
+    N, fed = ids.shape[0], 0
+    published_by_row = {}
+
+    def on_admitted(rows):
+        if pool is None:
+            return
+        for row in rows:
+            blocks = published_by_row.pop(row, None)
+            if blocks:
+                pool.mark_ready(blocks)
+
+    engine._admit_listener = on_admitted
+    got = {}
+    while len(got) < N:
+        free = engine.free_capacity
+        if fed < N and free > 0:
+            take = min(free, engine.admit_width, N - fed)
+            batch = slice(fed, fed + take)
+            shared_maps = publish_maps = None
+            if pool is not None:
+                plans = [
+                    pool.plan_admission(ids[i], mask[i])
+                    for i in range(fed, fed + take)
+                ]
+                shared_maps = np.stack([p.shared_map for p in plans])
+                publish_maps = np.stack([p.publish_map for p in plans])
+            rows = engine.submit(
+                ids[batch], mask[batch],
+                shared_maps=shared_maps, publish_maps=publish_maps,
+            )
+            if pool is not None:
+                for row, plan in zip(rows, plans):
+                    if plan.published:
+                        published_by_row[row] = plan.published
+            fed += take
+        for group in engine.pump():
+            toks = np.asarray(group["tokens"])
+            for j, r in enumerate(group["rows"]):
+                got[r] = toks[j]
+    return got
+
+
+def main():
+    import jax
+
+    from trlx_tpu.serving.prefix_cache import PrefixBlockPool
+    from trlx_tpu.serving.spec_drafter import NGramDrafter, TrieDrafter
+
+    on_cpu = jax.default_backend() == "cpu"
+    trainer = build_trainer()
+    base = trainer.rollout_engine_obj
+    Q = base.Q
+    pool_blocks = 64
+    N = 32 if on_cpu else 128
+    rounds_n = 2 if on_cpu else 6
+
+    engines = {
+        "base": build_engines(trainer, 0, 0),
+        "spec": build_engines(trainer, MAX_DRAFT, 0),
+        "base_shared": build_engines(trainer, 0, pool_blocks),
+        "spec_shared": build_engines(trainer, MAX_DRAFT, pool_blocks),
+    }
+    print(
+        f"max_draft {engines['spec'].spec_max_draft}, "
+        f"block {base.block_size}, Q={Q}, R={base.R}",
+        file=sys.stderr,
+    )
+
+    def measure(name, seed):
+        engine = engines[name]
+        shared = name.endswith("_shared")
+        prng = np.random.default_rng(seed)
+        prefix = (
+            prng.integers(100, 500 if on_cpu else 40000, Q // 2)
+            .astype(np.int32)
+            if shared
+            else None
+        )
+        ids, mask = make_prompts(prng, N, Q, prefix)
+        pool = (
+            PrefixBlockPool(pool_blocks, engine.block_size, engine.n_blocks)
+            if shared
+            else None
+        )
+        if engine.spec_max_draft:
+            # fresh drafter per run: histories must not leak across
+            # rounds (row ids restart each phase)
+            engine.spec_drafter = (
+                TrieDrafter(pool=pool, max_draft=engine.spec_max_draft)
+                if pool is not None
+                else NGramDrafter(max_draft=engine.spec_max_draft)
+            )
+        trainer.rng = jax.random.PRNGKey(seed)
+        trainer.reset_rollout_phase()
+        engine.start_phase(
+            trainer.rollout_params(), trainer.rollout_phase_key()
+        )
+        t0 = time.time()
+        got = serve_rows(engine, ids, mask, pool)
+        wall = time.time() - t0
+        return wall, got, engine.stats
+
+    # warm every compiled program, and pin CPU-tier bitwise parity on
+    # the warming round (same seed per pair => same prompts + phase key;
+    # accepted tokens must be the tokens the one-token loop sampled)
+    warm = {name: measure(name, 1234) for name in engines}
+    for a, b in (("base", "spec"), ("base_shared", "spec_shared")):
+        rows_a, rows_b = warm[a][1], warm[b][1]
+        assert set(rows_a) == set(rows_b)
+        for r in rows_a:
+            np.testing.assert_array_equal(rows_a[r], rows_b[r])
+    print("parity: spec == one-token-loop tokens, sharing off AND on",
+          file=sys.stderr)
+
+    rounds = {name: [] for name in engines}
+    order = list(engines)
+    stats = {}
+    for r in range(rounds_n):
+        for name in order if r % 2 == 0 else reversed(order):
+            wall, _, st = measure(name, 7 + r)
+            rounds[name].append(wall)
+            stats[name] = st
+    med = {n: float(np.median(ts)) for n, ts in rounds.items()}
+    for name, ts in rounds.items():
+        print(
+            f"{name}: median {med[name]*1e3:.1f} ms  "
+            f"all {[round(x*1e3, 1) for x in ts]}",
+            file=sys.stderr,
+        )
+
+    st_s, st_ss = stats["spec"], stats["spec_shared"]
+    record = {
+        "metric": (
+            "spec_decode_serve_ms_cpu_tiny"
+            if on_cpu
+            else "spec_decode_serve_ms_B128_Q64_R48_gpt2s"
+        ),
+        **{f"{n}_ms": round(v * 1000, 1) for n, v in med.items()},
+        "spec_speedup": round(med["base"] / med["spec"], 3),
+        "spec_speedup_shared": round(
+            med["base_shared"] / med["spec_shared"], 3
+        ),
+        "max_draft": MAX_DRAFT,
+        "accept_rate": round(st_s.spec_accept_rate, 4),
+        "tokens_per_verify": round(st_s.spec_tokens_per_step, 4),
+        "accept_rate_shared": round(st_ss.spec_accept_rate, 4),
+        "tokens_per_verify_shared": round(st_ss.spec_tokens_per_step, 4),
+        "verify_steps": int(st_s.spec_steps),
+        "verify_steps_shared": int(st_ss.spec_steps),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(record))
+
+    assert record["accept_rate"] > 0, "CPU round must accept something"
+    assert record["tokens_per_verify"] > 1, (
+        "verify must average more than one committed token per step"
+    )
+
+    from trlx_tpu.utils.ab_record import record_latest
+
+    record_latest(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "AB_SPEC.json"),
+        record,
+    )
+    from trlx_tpu.telemetry.run_ledger import append_ab_manifest
+
+    append_ab_manifest("ab_spec", record)
 
 
 if __name__ == "__main__":

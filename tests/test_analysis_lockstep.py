@@ -553,6 +553,8 @@ def test_update_budgets_refuses_on_divergence(tmp_path, monkeypatch):
 
 # -------------------- engine 11: real-simulation canary ------------------- #
 
+@pytest.mark.slow  # tier-1 budget (ROADMAP): the lockstep-smoke CI
+# job runs the same 2-host sim + planted divergence per PR
 def test_ilql_two_host_lockstep_and_planted_divergence():
     # ONE real 2-host simulation serves both tier-1 canaries: with the
     # planted rank-0-only dispatch, host 0's log is the clean log plus

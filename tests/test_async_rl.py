@@ -263,6 +263,8 @@ def test_engine_push_between_harvest_and_refill():
     assert versions == sorted(versions)
 
 
+@pytest.mark.slow  # tier-1 budget (ROADMAP): chaos-smoke CI runs
+# the injected-failure matrix per PR
 def test_chaos_admit_under_async_surfaces_actor_dead():
     """Regression (chaos site ``engine.admit``): under async mode an
     injected admission failure must surface as an ``actor-dead`` health
@@ -382,6 +384,8 @@ def test_learner_side_error_not_wrapped_as_actor_dead():
     assert trainer.rollout_engine == "continuous"
 
 
+@pytest.mark.slow  # tier-1 budget (ROADMAP): async-smoke CI + the
+# cheaper poll-interval/staleness canaries cover this path per PR
 def test_forced_drain_with_inflight_leftovers_stays_serial():
     """Over-submission regression: when the draw chunk (8) does not
     divide the harvest-rounded target (20), drive() returns with rows

@@ -216,6 +216,8 @@ def test_seq2seq_grpo_learns():
     assert late > early + 0.15, (early, late, means)
 
 
+@pytest.mark.slow  # tier-1 budget (ROADMAP): the dp-mesh GRPO
+# learning canaries stay tier-1; pp composition rides the nightly
 def test_grpo_composes_with_pipeline_parallelism():
     """GRPO's hooks (group advantages, no GAE) compose with the pp forward
     path: a short run on a dp x pp mesh trains and stays finite."""

@@ -283,8 +283,9 @@ def main(argv=None) -> int:
         "--engine-step",
         action="store_true",
         help="with --sanitize ppo: replay the continuous-batching "
-        "engine's decode_step (docs/inference.md) on a concretely "
-        "prefilled slot pool instead of the train step",
+        "engine's decode_step, then the speculative verify_step "
+        "(docs/inference.md) on a concretely prefilled slot pool "
+        "instead of the train step",
     )
     parser.add_argument(
         "--paths",

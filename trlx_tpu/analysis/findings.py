@@ -142,6 +142,29 @@ def suppressed_rules_on_line(source_line: str) -> Optional[set]:
     return {r.strip() for r in m.group(1).split(",") if r.strip()}
 
 
+def _read_lines(path: str) -> List[str]:
+    """Source lines of ``path``, also trying the package root for the
+    package-relative paths jaxpr-engine findings carry (their files are
+    relativized against ``trlx_tpu/``, not the process CWD — without
+    this, inline directives on jaxpr findings only worked when the
+    analysis ran from inside the package)."""
+    import os
+
+    candidates = [path]
+    if not os.path.isabs(path):
+        candidates.append(
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), path)
+        )
+    for cand in candidates:
+        try:
+            with open(cand, encoding="utf-8") as fh:
+                return fh.read().splitlines()
+        except OSError:
+            continue
+    return []
+
+
 def filter_suppressed(
     findings: Sequence[Finding],
     source_lines: Optional[Dict[str, List[str]]] = None,
@@ -159,11 +182,7 @@ def filter_suppressed(
             kept.append(f)
             continue
         if f.file not in cache:
-            try:
-                with open(f.file, encoding="utf-8") as fh:
-                    cache[f.file] = fh.read().splitlines()
-            except OSError:
-                cache[f.file] = []
+            cache[f.file] = _read_lines(f.file)
         lines = cache[f.file]
         if 1 <= f.line <= len(lines):
             rules = suppressed_rules_on_line(lines[f.line - 1])

@@ -798,7 +798,9 @@ def _trace_engine_programs(trainer, kind: str, mesh_shape) -> List[TracedProgram
         ),
     ] + _trace_chunked_prefill_programs(
         trainer, engine, kind, mesh_shape, shared=False
-    ) + _trace_serving_engine_programs(trainer, engine, kind, mesh_shape)
+    ) + _trace_serving_engine_programs(
+        trainer, engine, kind, mesh_shape
+    ) + _trace_spec_engine_programs(trainer, engine, kind, mesh_shape)
 
 
 def _trace_chunked_prefill_programs(
@@ -1088,6 +1090,98 @@ def _trace_serving_engine_programs(
     ] + _trace_chunked_prefill_programs(
         trainer, serving_engine, kind, mesh_shape, shared=True
     )
+
+
+def _trace_spec_engine_programs(
+    trainer, engine, kind: str, mesh_shape
+) -> List[TracedProgram]:
+    """Trace the speculative-decoding ``verify_step`` program
+    (docs/inference.md "Speculative decoding"): the multi-token
+    drafted verify pass that replaces ``decode_step`` when the
+    host-side drafter proposed tokens. Neither the trainer collect
+    path nor the default serving build compiles it unless
+    ``rollout.spec_decode.enabled`` — so like the serving tier above,
+    spec engines are constructed separately here and the default
+    engines' subjects stay byte-identical. Two variants:
+
+    - ``engine_verify_step`` — trainer-shaped build (no prefix pool),
+      the program behind tier-1 spec-on/spec-off bitwise parity;
+    - ``engine_verify_step_shared`` — serving-shaped build (shared
+      pool + streaming taps), whose cache state additionally carries
+      the replicated shared-block pool the verify gather reads
+      through.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.inference.engine import ContinuousBatchingEngine
+    from trlx_tpu.parallel.mesh import batch_sharding
+
+    axes = set(trainer.mesh.axis_names)
+    params_sds = _sds(trainer.state.params)
+    params_sh = trainer.state_shardings.params
+    batch_sh = batch_sharding(trainer.mesh)
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+
+    common = dict(
+        apply_fn=engine._apply_fn,
+        init_cache_fn=engine._init_cache_fn,
+        gen_config=engine.gen_config,
+        query_length=engine.Q,
+        vocab_size=engine.vocab_size,
+        num_slots=engine.num_slots,
+        admit_width=engine.admit_width,
+        harvest_width=engine.harvest_width,
+        block_size=engine.block_size,
+        mesh=engine.mesh,
+        param_shardings=engine._param_shardings,
+        cache_sharding=engine._cache_sharding,
+        with_values=engine.with_values,
+        spec_max_draft=4,
+    )
+    out: List[TracedProgram] = []
+    for suffix, extra in (
+        ("", {}),
+        (
+            "_shared",
+            dict(
+                prefix_pool_blocks=max(2, engine.Q // engine.block_size),
+                stream_taps=True,
+            ),
+        ),
+    ):
+        spec_engine = ContinuousBatchingEngine(**common, **extra)
+        if spec_engine.verify_step_jit is None:
+            continue  # spec_max_draft clamped to 0 (R == 1)
+        state_sds = jax.eval_shape(spec_engine._make_state)
+        state_sh = spec_engine.state_sharding()
+        B, D = spec_engine.num_slots, spec_engine.spec_max_draft
+        verify_args = (params_sds, state_sds, i32(B, D), i32(B))
+        verify_prefixes = ("params", "state", "draft", "draft_len")
+        verify_shardings = (params_sh, state_sh, batch_sh, batch_sh)
+        out.append(
+            TracedProgram(
+                subject=f"{kind}.engine_verify_step{suffix}",
+                closed_jaxpr=jax.make_jaxpr(spec_engine.verify_step_jit)(
+                    *verify_args
+                ),
+                mesh_axes=axes,
+                input_paths=flat_input_paths(
+                    *verify_args, prefixes=verify_prefixes
+                ),
+                mesh_shape=mesh_shape,
+                input_divisors=flat_sharding_divisors(
+                    verify_args, verify_shardings
+                ),
+                input_sharded_dims=flat_sharded_dims(
+                    verify_args, verify_shardings
+                ),
+                def_site=callable_def_site(spec_engine.verify_step_jit),
+                jit_fn=spec_engine.verify_step_jit,
+                example_args=verify_args,
+            )
+        )
+    return out
 
 
 def trace_all(

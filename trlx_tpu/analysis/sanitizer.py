@@ -373,16 +373,22 @@ def sanitize_engine_step(
     plant: bool = False,
     seed: int = 0,
 ) -> SanitizeResult:
-    """Replay the continuous-batching engine's ``decode_step``
-    (``trlx_tpu/inference/engine.py``) eqn-by-eqn on a concretely
-    prefilled slot pool.
+    """Replay the continuous-batching engine's ``decode_step``, then
+    its speculative ``verify_step`` (``trlx_tpu/inference/engine.py``),
+    eqn-by-eqn on a concretely prefilled slot pool.
 
     The state is produced the way production produces it — a real
     ``start_phase`` + admission prefill over random prompts — so a
     NaN minted anywhere in the decode path (paged-cache gather, per-row
     bias, token selection, value head) is localized to its first
     offending equation exactly like ``--sanitize``'s train-step replay.
-    ``plant`` poisons one param leaf first (the CLI self-check).
+    The verify replay runs the multi-token drafted pass
+    (docs/inference.md "Speculative decoding") on a separately built
+    spec-enabled engine with every slot carrying a full-width random
+    draft — acceptance is irrelevant to the replay; rejected columns
+    still exercise the OOB-sentinel write and masked-softmax paths
+    where a NaN would mint. ``plant`` poisons one param leaf first
+    (the CLI self-check; the decode replay finds it and short-circuits).
     """
     import numpy as np
 
@@ -415,13 +421,70 @@ def sanitize_engine_step(
     args = jax.tree_util.tree_leaves((params, state))
     names = flat_input_paths(params, state, prefixes=("params", "state"))
     mesh_shape = {k: int(v) for k, v in trainer.mesh.shape.items()}
-    return sanitize_jaxpr(
+    decode_result = sanitize_jaxpr(
         closed,
         args,
         subject=f"{kind}.engine_decode_step"
         + (".planted" if plant else ""),
         mesh=mesh_shape,
         arg_names=names,
+    )
+    if decode_result.offence is not None:
+        return decode_result
+
+    import jax.numpy as jnp
+
+    from trlx_tpu.inference.engine import ContinuousBatchingEngine
+
+    spec_engine = ContinuousBatchingEngine(
+        apply_fn=engine._apply_fn,
+        init_cache_fn=engine._init_cache_fn,
+        gen_config=engine.gen_config,
+        query_length=engine.Q,
+        vocab_size=engine.vocab_size,
+        num_slots=engine.num_slots,
+        admit_width=engine.admit_width,
+        harvest_width=engine.harvest_width,
+        block_size=engine.block_size,
+        mesh=engine.mesh,
+        param_shardings=engine._param_shardings,
+        cache_sharding=engine._cache_sharding,
+        with_values=engine.with_values,
+        spec_max_draft=4,
+    )
+    if spec_engine.verify_step_jit is None:
+        return decode_result
+    spec_engine.start_phase(params, jax.random.PRNGKey(seed))
+    spec_engine.submit(ids, mask)
+    spec_engine._admit()
+    B, D = spec_engine.num_slots, spec_engine.spec_max_draft
+    draft = jnp.asarray(
+        rng.integers(1, max(2, vocab - 2), (B, D)).astype(np.int32)
+    )
+    lens = jnp.full((B,), D, jnp.int32)
+    verify_args_tree = (params, spec_engine._state, draft, lens)
+    closed_v = jax.make_jaxpr(spec_engine.verify_step_jit)(
+        *verify_args_tree
+    )
+    verify_result = sanitize_jaxpr(
+        closed_v,
+        jax.tree_util.tree_leaves(verify_args_tree),
+        subject=f"{kind}.engine_verify_step",
+        mesh=mesh_shape,
+        arg_names=flat_input_paths(
+            *verify_args_tree,
+            prefixes=("params", "state", "draft", "draft_len"),
+        ),
+    )
+    if verify_result.offence is not None:
+        return verify_result
+    return SanitizeResult(
+        subject=f"{kind}.engine_decode_step+engine_verify_step",
+        mesh=mesh_shape,
+        n_eqns_checked=(
+            decode_result.n_eqns_checked + verify_result.n_eqns_checked
+        ),
+        offence=None,
     )
 
 

@@ -32,6 +32,68 @@ from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 ROLLOUT_ENGINES = ("fixed", "continuous")
+SPEC_DRAFTERS = ("trie", "ngram")
+
+
+@dataclass(frozen=True)
+class SpecDecodeConfig:
+    """Parsed ``train.rollout.spec_decode`` section
+    (docs/inference.md "Speculative decoding").
+
+    :param enabled: turn drafted verify steps on. Off — the default —
+        keeps every jitted engine program byte-identical to the
+        spec-less build.
+    :param max_draft: draft-token cap per slot per verify step (the
+        verify program forwards ``max_draft + 1`` columns); clamped by
+        the engine to ``max_new_tokens - 1``.
+    :param drafter: ``"trie"`` (shared-prefix-trie corpus + per-row
+        n-gram fallback, :class:`trlx_tpu.serving.TrieDrafter`) or
+        ``"ngram"`` (per-row self-lookup only).
+    :param min_accept_ewma: per-tenant accept-rate floor below which a
+        tenant's rows degrade to one-token decode (graceful — drafting
+        resumes if later probe drafts raise the EWMA back over the
+        bar). 0 never degrades.
+    """
+
+    enabled: bool = False
+    max_draft: int = 4
+    drafter: str = "trie"
+    min_accept_ewma: float = 0.0
+
+    def __post_init__(self):
+        if self.max_draft < 1:
+            raise ValueError(
+                f"train.rollout spec_decode.max_draft={self.max_draft} "
+                "must be >= 1"
+            )
+        if self.drafter not in SPEC_DRAFTERS:
+            raise ValueError(
+                f"train.rollout spec_decode.drafter={self.drafter!r} is "
+                f"not supported (choose one of {SPEC_DRAFTERS})"
+            )
+        if not 0.0 <= self.min_accept_ewma <= 1.0:
+            raise ValueError(
+                "train.rollout spec_decode.min_accept_ewma="
+                f"{self.min_accept_ewma} must be in [0, 1]"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "SpecDecodeConfig":
+        d = dict(d or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"Unknown train.rollout spec_decode keys: "
+                f"{sorted(unknown)} (known: {sorted(known)})"
+            )
+        if "enabled" in d and d["enabled"] is not None:
+            d["enabled"] = bool(d["enabled"])
+        if "max_draft" in d and d["max_draft"] is not None:
+            d["max_draft"] = int(d["max_draft"])
+        if "min_accept_ewma" in d and d["min_accept_ewma"] is not None:
+            d["min_accept_ewma"] = float(d["min_accept_ewma"])
+        return cls(**d)
 
 
 @dataclass(frozen=True)
@@ -85,6 +147,12 @@ class RolloutEngineConfig:
         interleaves with decode steps instead of stalling them. 0 =
         unbounded; the trainer collect loop (``drive``) always completes
         an admission inline.
+    :param spec_decode: speculative-decoding section
+        (:class:`SpecDecodeConfig`): host drafter + multi-token verify
+        steps, bitwise-pinned against the one-token loop
+        (docs/inference.md "Speculative decoding"). ``None``/disabled
+        keeps the engine's jitted programs byte-identical to the
+        spec-less build. Continuous engine only.
     """
 
     engine: str = "fixed"
@@ -96,8 +164,19 @@ class RolloutEngineConfig:
     per_row_rng: Optional[bool] = None
     prefill_chunk: int = 0
     prefill_chunks_per_pump: int = 0
+    spec_decode: Optional[SpecDecodeConfig] = None
 
     def __post_init__(self):
+        if (
+            self.spec_decode is not None
+            and self.spec_decode.enabled
+            and self.engine != "continuous"
+        ):
+            raise ValueError(
+                "train.rollout spec_decode.enabled needs the continuous "
+                f"engine (got engine={self.engine!r}) — the fixed "
+                "sampler has no verify step"
+            )
         if self.engine not in ROLLOUT_ENGINES:
             raise ValueError(
                 f"train.rollout engine={self.engine!r} is not supported "
@@ -146,6 +225,8 @@ class RolloutEngineConfig:
         ):
             if name in d and d[name] is not None:
                 d[name] = int(d[name])
+        if "spec_decode" in d and isinstance(d["spec_decode"], dict):
+            d["spec_decode"] = SpecDecodeConfig.from_dict(d["spec_decode"])
         return cls(**d)
 
     @property
@@ -159,5 +240,7 @@ class RolloutEngineConfig:
 
 __all__ = [
     "ROLLOUT_ENGINES",
+    "SPEC_DRAFTERS",
     "RolloutEngineConfig",
+    "SpecDecodeConfig",
 ]

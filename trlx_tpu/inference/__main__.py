@@ -159,6 +159,12 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
       staying bitwise-served (the parity contract is pinned in
       tests/test_chunked_prefill.py; here the gate is that the chunked
       serving path carries real multi-tenant traffic cleanly);
+    - **speculative decoding**: the scenario serves through the
+      trie-drafted spec path (``rollout.spec_decode`` with the
+      ``drafter: trie`` wired to the shared-prefix pool), must report
+      ``engine/spec_accept_rate > 0``, and a spec-off rerun over the
+      same prompts must reproduce every served row bitwise (the verify
+      step's acceptance contract, end to end);
     - **zero health events** on this clean run.
 
     ``span_log`` exports the whole span stream (phase + request spans
@@ -173,51 +179,68 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
     from trlx_tpu.inference.server import InferenceServer
 
     scfg = harness.tiny_config_dict("ppo", mesh=mesh)
+    # near-greedy decode with a longer budget: random-init generation
+    # falls into short loops the trie/n-gram drafter locks onto, so the
+    # spec path sees real acceptance (same trick as ab_spec.py)
+    scfg["method"]["gen_kwargs"].update(
+        {"temperature": 0.05, "max_new_tokens": 16, "min_new_tokens": 8}
+    )
     scfg["train"]["rollout"] = {
+        # serving ignores the trainer-side engine choice, but
+        # spec_decode's config validation pins it to "continuous"
+        "engine": "continuous",
         "slots": 4, "admit_width": 2, "harvest_width": 2, "block_size": 4,
         # chunked prefill, serving tier: admission prefill runs as
         # need-gated prompt-column chunks, at most one chunk forward
         # per pump (stall-free admission under bursts)
         "prefill_chunk": 4, "prefill_chunks_per_pump": 1,
+        # speculative decoding through the shared-prefix trie drafter
+        # (docs/inference.md "Speculative decoding")
+        "spec_decode": {"enabled": True, "max_draft": 4, "drafter": "trie"},
     }
-    server = InferenceServer(
-        TRLConfig.from_dict(scfg),
-        serving={
-            "prefix_cache_blocks": 16,
-            # generous CPU-tier budgets (queue waits include compile
-            # walls); the slo-breach detector is unit-tested with tight
-            # budgets in tests/test_serving.py
-            "slo_classes": {
-                "interactive": {"queue_wait_budget_ms": 120000},
-                "standard": {"queue_wait_budget_ms": 120000},
-            },
-            "tenants": {
-                "gold": {"priority": 10, "slo_class": "interactive"},
-                # burst covers ONE request's cost (Q + R tokens), the
-                # rate refills roughly two requests/second: bronze is
-                # throttled to a trickle but never starves
-                "bronze": {
-                    "priority": 0, "rate": 30.0, "burst": 14.0,
-                    "slo_class": "standard",
-                },
+    serving_cfg = {
+        "prefix_cache_blocks": 16,
+        # generous CPU-tier budgets (queue waits include compile
+        # walls); the slo-breach detector is unit-tested with tight
+        # budgets in tests/test_serving.py
+        "slo_classes": {
+            "interactive": {"queue_wait_budget_ms": 120000},
+            "standard": {"queue_wait_budget_ms": 120000},
+        },
+        "tenants": {
+            "gold": {"priority": 10, "slo_class": "interactive"},
+            # burst covers ONE request's cost (Q + R tokens), the
+            # rate refills roughly two requests/second: bronze is
+            # throttled to a trickle but never starves
+            "bronze": {
+                "priority": 0, "rate": 60.0, "burst": 26.0,
+                "slo_class": "standard",
             },
         },
-    )
+    }
+    server = InferenceServer(TRLConfig.from_dict(scfg), serving=serving_cfg)
     Q, R = server.query_length, server.engine.R
     rng = np.random.default_rng(0)
     system_prefix = [5, 6, 7, 8]  # shared across BOTH tenants
     def make_prompts(n):
-        return [
-            system_prefix + list(rng.integers(1, 30, Q - len(system_prefix)))
-            for _ in range(n)
-        ]
+        # cyclic two-token tails: every suffix recurs, so the drafter
+        # has n-gram matches from the first decode step
+        out = []
+        for _ in range(n):
+            a, b = (int(x) for x in rng.integers(1, 30, 2))
+            tail = list(np.tile([a, b], Q))[: Q - len(system_prefix)]
+            out.append(system_prefix + tail)
+        return out
 
+    bronze_prompts = make_prompts(4)
+    gold_prompts = make_prompts(4)
+    stream_prompts = make_prompts(1)
     # low-priority bronze submits FIRST; gold afterwards — priority
     # admission must still serve gold ahead of bronze
-    bronze = server.submit(make_prompts(4), tenant="bronze")
-    gold = server.submit(make_prompts(4), tenant="gold")
+    bronze = server.submit(bronze_prompts, tenant="bronze")
+    gold = server.submit(gold_prompts, tenant="gold")
     stream_rid = server.submit(
-        make_prompts(1), tenant="gold", stream=True
+        stream_prompts, tenant="gold", stream=True
     )[0]
 
     # streamed TTFT: pull the first token through the stream iterator
@@ -253,7 +276,35 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
         if tracer.enabled
         else []
     )
+
+    # spec-off rerun: the same config with spec_decode disabled, the
+    # same prompts in the same submission order (=> identical draw
+    # positions => identical per-row keys), so every served row must be
+    # BITWISE what the one-token loop produces — the verify step's
+    # acceptance contract, exercised end-to-end through real
+    # multi-tenant traffic
+    import copy
+
+    scfg_off = copy.deepcopy(scfg)
+    scfg_off["train"]["rollout"].pop("spec_decode")
+    server_off = InferenceServer(
+        TRLConfig.from_dict(scfg_off), serving=serving_cfg
+    )
+    off_bronze = server_off.submit(bronze_prompts, tenant="bronze")
+    off_gold = server_off.submit(gold_prompts, tenant="gold")
+    off_stream = server_off.submit(stream_prompts, tenant="gold")
+    results_off = server_off.wait(off_bronze + off_gold + off_stream)
+    spec_parity = all(
+        results[a]["tokens"] == results_off[b]["tokens"]
+        for a, b in zip(
+            bronze + gold + [stream_rid],
+            off_bronze + off_gold + off_stream,
+        )
+    )
+
     record = {
+        "spec_drafter": type(server.engine.spec_drafter).__name__,
+        "spec_off_row_parity": bool(spec_parity),
         "completion_order_tenants": [
             "gold" if r in set(gold + [stream_rid]) else "bronze"
             for r in order
@@ -326,6 +377,21 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
             "chunked prefill never ran (engine/prefill_chunks == 0) "
             "despite rollout.prefill_chunk being set"
         )
+    if not stats["engine/spec_accept_rate"] > 0:  # tpu-lint: disable=host-branch
+        failures.append(
+            "spec decode accepted nothing (engine/spec_accept_rate == 0) "
+            "despite rollout.spec_decode being enabled"
+        )
+    if not spec_parity:
+        failures.append(
+            "spec-on served rows are not bitwise-identical to the "
+            "spec-off rerun"
+        )
+    if server_off.health_events:
+        failures.append(
+            f"{len(server_off.health_events)} health events on the "
+            "spec-off rerun"
+        )
     if telemetry.get_metrics().enabled:
         for tenant in ("gold", "bronze"):
             key = f"serve/queue_wait_ms[tenant={tenant}]"
@@ -359,7 +425,8 @@ def multi_tenant_smoke(mesh=None, span_log=None) -> int:
         f"{stats['engine/prefix_hit_rate']:.2f}, "
         f"{stats['engine/prefill_chunks']:.0f} prefill chunks "
         f"({stats['engine/prefill_cols_skipped']:.0f} cols skipped), "
-        "zero health events",
+        f"spec accept rate {stats['engine/spec_accept_rate']:.2f} "
+        "(bitwise vs spec-off), zero health events",
         file=sys.stderr,
     )
     return 0

@@ -77,6 +77,7 @@ from trlx_tpu import telemetry
 from trlx_tpu.inference.kv_cache import choose_block_size
 from trlx_tpu.ops.sampling import (
     GenerationConfig,
+    accept_drafts,
     choose_tokens,
     concat_cols,
     make_row_keys,
@@ -134,6 +135,16 @@ class EngineStats:
     prefix_lookup_blocks: int = 0
     prefix_hit_blocks: int = 0
     prefix_published_blocks: int = 0
+    # speculative decoding (rollout.spec_decode): verify steps
+    # dispatched, (row, step) pairs that proposed a draft, draft tokens
+    # proposed/accepted (anchors excluded — they are ordinary decode
+    # tokens), and the proposed lengths (the p50 gauge's sample set,
+    # bounded by the phase's step count)
+    spec_steps: int = 0
+    spec_row_steps: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_draft_lens: List[int] = dataclasses.field(default_factory=list)
 
     @property
     def slot_util(self) -> float:
@@ -152,6 +163,26 @@ class EngineStats:
         redirected into the shared pool)."""
         return self.prefix_hit_blocks + self.prefix_published_blocks
 
+    @property
+    def spec_accept_rate(self) -> float:
+        if not self.spec_drafted:
+            return 0.0
+        return self.spec_accepted / self.spec_drafted
+
+    @property
+    def spec_tokens_per_step(self) -> float:
+        """Tokens committed per drafted (row, step): the anchor (always
+        accepted for a live row) plus the accepted draft prefix."""
+        if not self.spec_row_steps:
+            return 0.0
+        return 1.0 + self.spec_accepted / self.spec_row_steps
+
+    @property
+    def spec_draft_len_p50(self) -> float:
+        if not self.spec_draft_lens:
+            return 0.0
+        return float(np.median(self.spec_draft_lens))
+
     def to_dict(self) -> Dict[str, float]:
         return {
             "engine/admitted": float(self.admitted),
@@ -168,6 +199,11 @@ class EngineStats:
             "engine/prefill_flops_saved": float(self.prefill_flops_saved),
             "engine/prefix_hit_rate": round(self.prefix_hit_rate, 4),
             "engine/prefix_blocks_saved": float(self.prefix_blocks_saved),
+            "engine/spec_draft_len_p50": round(self.spec_draft_len_p50, 4),
+            "engine/spec_accept_rate": round(self.spec_accept_rate, 4),
+            "engine/spec_tokens_per_step": round(
+                self.spec_tokens_per_step, 4
+            ),
         }
 
 
@@ -235,6 +271,29 @@ class ContinuousBatchingEngine:
         prefill dispatches in one pump, as the monolithic path does).
         :meth:`drive` (the trainer collect loop) always completes an
         admission inline regardless.
+    :param spec_max_draft: speculative decoding (``rollout.spec_decode``,
+        docs/inference.md): ``> 0`` adds a jitted ``verify_step`` program
+        that forwards each slot's anchor sample plus up to this many
+        host-drafted tokens in ONE pass and accepts the longest prefix
+        where the target sample equals the draft — bitwise the one-token
+        loop's tokens under the per-row RNG contract
+        (``ops/sampling.py::accept_drafts``). Rows with no draft ride
+        through with ``draft_len 0`` (anchor-only — exactly a decode
+        step), and a round where nothing drafted dispatches the plain
+        ``decode_step``. Forces :attr:`stream_taps` on: the host drafter
+        needs per-step token visibility to keep its histories. 0 — the
+        default, and every pre-existing path — builds no verify program
+        and keeps all other programs byte-identical.
+    :param spec_drafter: host-side drafter
+        (:mod:`trlx_tpu.serving.spec_drafter` API: ``observe_context`` /
+        ``observe_tokens`` / ``observe_accept`` / ``draft`` / ``forget``).
+        ``None`` with ``spec_max_draft > 0`` builds the n-gram
+        self-lookup drafter; the serving tier passes the trie drafter
+        bound to its shared-prefix pool.
+    :param spec_min_accept_ewma: accept-rate floor handed to the default
+        drafter — a row/tenant whose acceptance EWMA falls below it
+        stops drafting (graceful per-slot degrade to one-token decode,
+        never an abort).
     """
 
     def __init__(
@@ -258,6 +317,9 @@ class ContinuousBatchingEngine:
         stream_taps: bool = False,
         prefill_chunk: int = 0,
         prefill_chunks_per_pump: int = 0,
+        spec_max_draft: int = 0,
+        spec_drafter=None,
+        spec_min_accept_ewma: float = 0.0,
     ):
         from trlx_tpu.inference.kv_cache import choose_prefill_chunk
 
@@ -270,7 +332,27 @@ class ContinuousBatchingEngine:
         self.block_size = choose_block_size(self.capacity, block_size)
         self.n_blocks = self.capacity // self.block_size
         self.prefix_pool_blocks = int(prefix_pool_blocks)
-        self.stream_taps = bool(stream_taps)
+        if spec_max_draft < 0:
+            raise ValueError(
+                f"spec_max_draft={spec_max_draft} must be >= 0 (0 "
+                "disables speculative decoding)"
+            )
+        # the verify window is draft + anchor; a draft wider than R-1
+        # could never be fully accepted (per-position budget guard), so
+        # shrink silently like choose_block_size does
+        self.spec_max_draft = min(int(spec_max_draft), max(0, self.R - 1))
+        self.spec_min_accept_ewma = float(spec_min_accept_ewma)
+        self.spec_drafter = spec_drafter
+        if self.spec_max_draft > 0 and self.spec_drafter is None:
+            from trlx_tpu.serving.spec_drafter import NGramDrafter
+
+            self.spec_drafter = NGramDrafter(
+                max_draft=self.spec_max_draft,
+                min_accept_ewma=self.spec_min_accept_ewma,
+            )
+        # spec decode needs per-step token visibility host-side (drafter
+        # histories), which is exactly the streaming tap
+        self.stream_taps = bool(stream_taps) or self.spec_max_draft > 0
         self.prefill_chunk = choose_prefill_chunk(
             self.Q, int(prefill_chunk), self.block_size
         )
@@ -360,6 +442,10 @@ class ContinuousBatchingEngine:
         # forwards per iteration; drive() completes it inline
         self._inflight_admission: Optional[Dict[str, Any]] = None
         self._chunk_flops: Optional[float] = None  # lazy exact per-chunk cost
+        # spec decode: the next step's prefetched (draft, lens) host
+        # arrays — invalidated by a weight push, an admission, or a
+        # harvest (anything that changes what the pool is decoding)
+        self._staged_drafts: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._recycle_counts = np.zeros(self.num_slots, np.int64)
         self._next_row = 0
         # behavior-policy versioning (async actor–learner): every slot
@@ -758,6 +844,130 @@ class ContinuousBatchingEngine:
             finished = state.finished.at[slot_ids].set(True, mode="drop")
             return dataclasses.replace(state, finished=finished)
 
+        # ------------- speculative verify (rollout.spec_decode) ------------ #
+        D = self.spec_max_draft
+
+        def verify_step(params, state: EngineState, draft, draft_len):
+            """Drafted multi-token decode: sample each slot's anchor
+            token from the carried logits (always the correct next token
+            — all-rejected still commits it), forward the anchor plus up
+            to D host-drafted tokens in ONE pass through the paged
+            cache, and accept the longest draft prefix where the target
+            sample equals the draft (``accept_drafts`` — bitwise the
+            one-token loop's tokens under the per-row keys). Accepted
+            emissions land exactly where sequential decode would put
+            them; rejected/beyond-draft columns write at the per-column
+            OOB sentinel and their outputs are never read (garbage KV
+            above the accept frontier is either causally masked to
+            exactly-zero softmax weight or overwritten by a later step's
+            scatter before its first unmasked read). The carried
+            logits/value are re-anchored at the LAST accepted column, so
+            verify and decode steps mix freely over the same state."""
+            if cfg.min_new_tokens > 0 or cfg.min_length > 0:
+                min_new = jnp.maximum(
+                    cfg.min_new_tokens, cfg.min_length - state.n_real
+                )
+            else:
+                min_new = None
+            token0, live0, lp0, v0, fin1 = choose_tokens(
+                cfg,
+                state.logits_last,
+                state.t,
+                state.finished,
+                state.value_last,
+                state.n_real,
+                min_new=min_new,
+                row_keys=state.row_keys,
+            )
+            T = D + 1
+            col = jnp.arange(T, dtype=jnp.int32)[None, :]
+            inputs = concat_cols(token0[:, None], draft)
+            # per-column cache targets: anchor + valid draft columns land
+            # at Q+t+j, everything else at capacity (per-column OOB drop
+            # — the idle-slot sentinel applied columnwise)
+            write_pos = jnp.where(
+                (live0 == 1)[:, None] & (col <= draft_len[:, None]),
+                Q + state.t[:, None] + col,
+                cap,
+            )
+            slot_pos = jnp.arange(cap)[None, :]
+            # window-wide validity mask: the causal bias (base column =
+            # write_pos[:, 0]) narrows each query j to <= Q+t+j, and the
+            # extra columns it excludes carry exactly-zero softmax
+            # weight — bitwise the one-token step's attention per query
+            cache_mask_t = (
+                slot_pos <= Q + state.t[:, None] + D
+            ).astype(jnp.int32) * concat_cols(
+                state.query_mask, jnp.ones((B, R), state.query_mask.dtype)
+            )
+            out = apply_fn(
+                params,
+                inputs,
+                attention_mask=cache_mask_t,
+                position_ids=(state.n_real + state.t)[:, None] + col,
+                cache=state.cache,
+                cache_index=write_pos,
+            )
+            logits_seq = out["logits"].astype(jnp.float32)
+            values_seq = (
+                out["values"].astype(jnp.float32)
+                if with_values
+                else jnp.zeros((B, T), jnp.float32)
+            )
+            d_toks, d_acc, d_lps, d_vals, n_acc, fin = accept_drafts(
+                cfg,
+                logits_seq[:, :-1],
+                values_seq[:, :-1],
+                state.t,
+                fin1,
+                live0 == 1,
+                state.n_real,
+                draft,
+                draft_len,
+                state.row_keys,
+                min_new=min_new,
+                budget=R,
+            )
+            tokens_bt = concat_cols(token0[:, None], d_toks)
+            acc_bt = concat_cols(live0[:, None], d_acc)
+            lps_bt = concat_cols(lp0[:, None], d_lps)
+            vals_bt = concat_cols(v0[:, None], d_vals)
+            rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+            w = jnp.where(acc_bt == 1, state.t[:, None] + col, R)
+            out_tokens = state.out_tokens.at[rows, w].set(
+                tokens_bt, mode="drop"
+            )
+            out_mask = state.out_mask.at[rows, w].set(acc_bt, mode="drop")
+            out_logprobs = state.out_logprobs.at[rows, w].set(
+                lps_bt, mode="drop"
+            )
+            out_values = state.out_values.at[rows, w].set(
+                vals_bt, mode="drop"
+            )
+            # re-anchor the decode invariant: logits/value at the last
+            # accepted column predict the next un-emitted token
+            new_logits = jnp.take_along_axis(
+                logits_seq, n_acc[:, None, None], axis=1
+            )[:, 0]
+            new_value = jnp.take_along_axis(
+                values_seq, n_acc[:, None], axis=1
+            )[:, 0]
+            t_next = state.t + live0 + n_acc
+            done = state.active & (fin | (t_next >= R))
+            new_state = dataclasses.replace(
+                state,
+                cache=pin_cache(out["cache"]),
+                t=t_next,
+                logits_last=new_logits,
+                value_last=new_value,
+                finished=fin,
+                out_tokens=out_tokens,
+                out_mask=out_mask,
+                out_logprobs=out_logprobs,
+                out_values=out_values,
+            )
+            return new_state, done, tokens_bt, acc_bt
+
         # ------------- chunked prefill (rollout.prefill_chunk) ------------- #
         # The monolithic `prefill` above pays full prompt-capacity
         # attention FLOPs for every admitted row. These two programs
@@ -1012,6 +1222,30 @@ class ContinuousBatchingEngine:
                     prefill_finish, donate_argnums=(1,)
                 )
 
+        self.verify_step_jit = None
+        if D > 0:
+            if self.mesh is not None and self._param_shardings is not None:
+                from trlx_tpu.parallel.mesh import batch_sharding, replicated
+
+                state_sh = self.state_sharding()
+                batch_sh = batch_sharding(self.mesh)
+                rep = replicated(self.mesh)
+                self.verify_step_jit = jax.jit(
+                    verify_step,
+                    in_shardings=(
+                        self._param_shardings,
+                        state_sh,
+                        batch_sh,
+                        batch_sh,
+                    ),
+                    out_shardings=(state_sh, rep, rep, rep),
+                    donate_argnums=(1,),
+                )
+            else:
+                self.verify_step_jit = jax.jit(
+                    verify_step, donate_argnums=(1,)
+                )
+
     # --------------------------- host loop ----------------------------- #
 
     def start_phase(self, params, phase_key, row_start: int = 0) -> None:
@@ -1028,6 +1262,11 @@ class ContinuousBatchingEngine:
         self._busy_rows = {}
         self._done_slots = []
         self._inflight_admission = None
+        self._staged_drafts = None
+        if self.spec_drafter is not None and hasattr(
+            self.spec_drafter, "reset"
+        ):
+            self.spec_drafter.reset()
         self._recycle_counts[:] = 0
         self._next_row = row_start
         self.param_version = 0
@@ -1069,6 +1308,13 @@ class ContinuousBatchingEngine:
         self.param_version = self._pending_version
         self._pending_params = None
         self._pending_version = None
+        # a weight push invalidates outstanding speculative drafts: the
+        # next verify step's targets come from the refreshed params, so
+        # prefetched proposals re-draft at the next step (drafts are
+        # param-independent token guesses — dropping them affects accept
+        # rate only, never correctness, but the invalidation keeps the
+        # drafting overlap window inside one params version)
+        self._staged_drafts = None
         self.stats.weight_pushes += 1
 
     def min_inflight_version(self) -> Optional[int]:
@@ -1304,6 +1550,15 @@ class ContinuousBatchingEngine:
                     self.stats.prefix_published_blocks += int(
                         np.sum(publish_map[i] >= 0)
                     )
+                if self.spec_drafter is not None and not release:
+                    # seed the drafter's per-row history with the real
+                    # prompt tokens (left-padded: the mask selects them
+                    # in order)
+                    self.spec_drafter.observe_context(
+                        row, [int(x) for x in np.asarray(ids)[
+                            np.asarray(mask).astype(bool)
+                        ]]
+                    )
             args = (prompt_ids, prompt_mask)
             if self.mesh is not None:
                 from trlx_tpu.parallel.mesh import batch_sharding
@@ -1489,6 +1744,9 @@ class ContinuousBatchingEngine:
                     )
         self.stats.prefills += 1
         self.stats.admitted += adm["take"]
+        # new occupants joined the pool: a prefetched draft matrix no
+        # longer covers it
+        self._staged_drafts = None
         registry = telemetry.get_metrics()
         if sharing:
             registry.gauge("engine/prefix_hit_rate").set(
@@ -1605,6 +1863,10 @@ class ContinuousBatchingEngine:
             for s in slots:
                 self._recycle_counts[s] += 1
                 self._free.append(s)
+            if self.spec_drafter is not None:
+                for r in rows:
+                    self.spec_drafter.forget(r)
+                self._staged_drafts = None
             self.stats.recycles += C
             self.stats.completed += C
             outs = dict(outs)
@@ -1680,7 +1942,122 @@ class ContinuousBatchingEngine:
                     f"harvest group ({len(self._done_slots)} done < "
                     f"{C}) — target/harvest_width mismatch"
                 )
-            self._decode_once()
+            self._step_once()
+
+    def _step_once(self) -> None:
+        """Advance every slot one step: the drafted ``verify_step`` when
+        spec decode is on and any slot proposed a draft, else the plain
+        one-token ``decode_step`` (the fall-through — draftless rounds
+        never pay the wider program)."""
+        if self.spec_max_draft > 0:
+            draft, lens = self._take_drafts()
+            if lens.any():
+                self._verify_once(draft, lens)
+                return
+        self._decode_once()
+
+    def _take_drafts(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The next step's per-slot draft matrix: the prefetched stage
+        if it survived (no weight push / admission / harvest since it
+        was drafted), else drafted fresh."""
+        if self._staged_drafts is not None:
+            staged = self._staged_drafts
+            self._staged_drafts = None
+            return staged
+        return self._draft_now()
+
+    def _draft_now(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Ask the drafter for up to ``spec_max_draft`` proposed tokens
+        per busy, not-yet-done slot. [B, D] int32 matrix + [B] lens."""
+        D = self.spec_max_draft
+        draft = np.zeros((self.num_slots, D), np.int32)
+        lens = np.zeros((self.num_slots,), np.int32)
+        if self.spec_drafter is None:
+            return draft, lens
+        done = set(self._done_slots)
+        for slot, row in self._busy_rows.items():
+            if slot in done:
+                continue
+            toks = self.spec_drafter.draft(row)
+            if not toks:
+                continue
+            toks = list(toks)[:D]
+            draft[slot, : len(toks)] = toks
+            lens[slot] = len(toks)
+        return draft, lens
+
+    def _verify_once(self, draft: np.ndarray, lens: np.ndarray) -> None:
+        """Dispatch one drafted verify step, land its accepted emissions
+        into the drafter histories / stream taps, and prefetch the next
+        step's drafts (host drafting overlaps the device's next work;
+        the stage is dropped if a push/admission/harvest intervenes)."""
+        self._state, done, toks, acc = self.verify_step_jit(
+            self._params,
+            self._state,
+            jnp.asarray(draft),
+            jnp.asarray(lens),
+        )
+        try:
+            done.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        self.stats.decode_steps += 1
+        self.stats.spec_steps += 1
+        self.stats.occupancy_sum += len(self._busy_rows)
+        if self.trace_requests:
+            self._step_log.append(
+                (telemetry.monotonic(), self.stats.prefills)
+            )
+        tok_host = np.asarray(jax.device_get(toks))
+        acc_host = np.asarray(jax.device_get(acc))
+        for slot, row in self._busy_rows.items():
+            n_cols = int(acc_host[slot].sum())  # anchor + accepted drafts
+            if lens[slot]:
+                n_drafted = int(lens[slot])
+                n_accepted = max(0, n_cols - 1)
+                self.stats.spec_row_steps += 1
+                self.stats.spec_drafted += n_drafted
+                self.stats.spec_accepted += n_accepted
+                self.stats.spec_draft_lens.append(n_drafted)
+                if self.spec_drafter is not None:
+                    self.spec_drafter.observe_accept(
+                        row, n_drafted, n_accepted
+                    )
+                marks = self._req_times.get(row)
+                if marks is not None:
+                    # ride the trace record: the serve/decode span's
+                    # spec_segments/accepted attrs keep --trace-report's
+                    # cadence estimator honest about multi-token steps
+                    marks["spec_segments"] = (
+                        marks.get("spec_segments", 0) + 1
+                    )
+                    marks["spec_accepted"] = (
+                        marks.get("spec_accepted", 0) + n_accepted
+                    )
+            if n_cols and self.spec_drafter is not None:
+                self.spec_drafter.observe_tokens(
+                    row, [int(t) for t in tok_host[slot, :n_cols]]
+                )
+        if self.token_sink is not None:
+            # route per accepted depth: each sink call keeps the
+            # one-token {row: token} contract, in emission order
+            for j in range(acc_host.shape[1]):
+                emitted = {
+                    row: int(tok_host[slot, j])
+                    for slot, row in self._busy_rows.items()
+                    if acc_host[slot, j]
+                }
+                if emitted:
+                    self.token_sink(emitted)
+        registry = telemetry.get_metrics()
+        registry.gauge("engine/spec_accept_rate").set(
+            self.stats.spec_accept_rate
+        )
+        registry.gauge("engine/spec_tokens_per_step").set(
+            self.stats.spec_tokens_per_step
+        )
+        self._staged_drafts = self._draft_now()
+        self._poll_done(done)
 
     def _decode_once(self) -> None:
         """Dispatch one decode step for the whole pool and run the
@@ -1709,26 +2086,41 @@ class ContinuousBatchingEngine:
             self._step_log.append(
                 (telemetry.monotonic(), self.stats.prefills)
             )
-        if token is not None and self.token_sink is not None:
+        need_tokens = (
+            self.token_sink is not None or self.spec_drafter is not None
+        )
+        if token is not None and need_tokens:
             # streaming tap: route this step's live emissions to the
             # per-request queues NOW — time-to-first-token decouples
             # from harvest-group completion (the per-step fetch is the
             # streaming cost; non-streaming runs leave token_sink unset
-            # and the unfetched outputs are dropped on device)
+            # and the unfetched outputs are dropped on device). Spec
+            # decode reads the same tap to keep the drafter histories
+            # current through draftless fall-through steps.
             tok_host = np.asarray(jax.device_get(token))
             live_host = np.asarray(jax.device_get(live))
-            emitted = {
-                row: int(tok_host[slot])
-                for slot, row in self._busy_rows.items()
-                if live_host[slot]
-            }
-            if emitted:
-                self.token_sink(emitted)
-        # amortized done polling: the flags are sticky (a finished
-        # slot stays done until harvested), so fetching only every
-        # k-th step's flags is exact — k=1 reproduces the
-        # poll-every-step loop bitwise, and the async copy above has
-        # k dispatches to land the transfer before the host reads it
+            if self.spec_drafter is not None:
+                for slot, row in self._busy_rows.items():
+                    if live_host[slot]:
+                        self.spec_drafter.observe_tokens(
+                            row, [int(tok_host[slot])]
+                        )
+            if self.token_sink is not None:
+                emitted = {
+                    row: int(tok_host[slot])
+                    for slot, row in self._busy_rows.items()
+                    if live_host[slot]
+                }
+                if emitted:
+                    self.token_sink(emitted)
+        self._poll_done(done)
+
+    def _poll_done(self, done) -> None:
+        """Amortized done polling: the flags are sticky (a finished slot
+        stays done until harvested), so fetching only every k-th step's
+        flags is exact — k=1 reproduces the poll-every-step loop
+        bitwise, and the async copy started at dispatch has k dispatches
+        to land the transfer before the host reads it."""
         self._steps_since_poll += 1
         if self._steps_since_poll < self.done_poll_interval:
             return
@@ -1799,5 +2191,5 @@ class ContinuousBatchingEngine:
                 self._apply_pending_push()
             self._admit()
         if self._busy_rows:
-            self._decode_once()
+            self._step_once()
         return groups

@@ -306,7 +306,7 @@ def paged_write_read(
     cache_kv: Dict[str, jax.Array],
     k: jax.Array,  # [B, T, H, Dh] new keys (compute dtype)
     v: jax.Array,
-    cache_index,  # scalar or [B] logical base position of the new rows
+    cache_index,  # scalar/[B] logical base position, or [B, T] per column
     dtype,
     view_len: int = 0,
 ) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
@@ -315,9 +315,13 @@ def paged_write_read(
     the whole buffer for attention (plus the updated cache dict).
 
     ``cache_index`` may be per-slot (the continuous engine's rows sit at
-    different depths) or scalar (broadcast). int8 pools quantize on write
-    and dequantize the gathered view — same bits as the linear int8 path
-    per logical position.
+    different depths), scalar (broadcast), or a full [B, T] per-column
+    position matrix — the speculative verify step's drafted window,
+    where each row writes only its first ``draft_len + 1`` columns and
+    parks the rest at ``capacity`` (the same OOB-drop sentinel idle
+    slots use, applied per column instead of per row). int8 pools
+    quantize on write and dequantize the gathered view — same bits as
+    the linear int8 path per logical position.
 
     ``view_len > 0`` narrows the returned logical view (and the shared
     overlay) to the leading ``view_len`` positions — chunk-granular
@@ -328,8 +332,14 @@ def paged_write_read(
     B, T = k.shape[0], k.shape[1]
     capacity = cache_kv["k"].shape[1]
     tables = cache_kv["block_tables"]
-    base = jnp.broadcast_to(jnp.asarray(cache_index, jnp.int32), (B,))
-    positions = base[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    idx = jnp.asarray(cache_index, jnp.int32)
+    if idx.ndim == 2:
+        # per-column targets: the caller names every column's logical
+        # position directly (OOB columns drop per element)
+        positions = idx
+    else:
+        base = jnp.broadcast_to(idx, (B,))
+        positions = base[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     phys = physical_positions(tables, positions, capacity)
     view = logical_view_index(tables, capacity)
     if 0 < view_len < capacity:

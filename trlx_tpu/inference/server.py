@@ -244,6 +244,8 @@ class InferenceServer:
 
         import functools
 
+        spec = rollout.spec_decode
+        spec_on = spec is not None and spec.enabled
         self.engine = ContinuousBatchingEngine(
             apply_fn=apply_fn,
             init_cache_fn=functools.partial(
@@ -263,6 +265,10 @@ class InferenceServer:
             stream_taps=True,
             prefill_chunk=rollout.prefill_chunk,
             prefill_chunks_per_pump=rollout.prefill_chunks_per_pump,
+            spec_max_draft=spec.max_draft if spec_on else 0,
+            spec_min_accept_ewma=(
+                spec.min_accept_ewma if spec_on else 0.0
+            ),
         )
         # fold_in consumes rng without a dangling split chain (the
         # key-lineage engine's key-discard rule)
@@ -292,6 +298,18 @@ class InferenceServer:
             if self.serving_config.prefix_cache_blocks > 0
             else None
         )
+        if spec_on and spec.drafter == "trie" and self.engine.spec_max_draft:
+            from trlx_tpu.serving.spec_drafter import TrieDrafter
+
+            # rebind the engine's default per-row n-gram drafter to the
+            # trie-backed one: the shared-prefix pool's published chains
+            # become the global draft corpus (pool=None — sharing off —
+            # keeps pure n-gram behavior)
+            self.engine.spec_drafter = TrieDrafter(
+                pool=self.prefix_pool,
+                max_draft=self.engine.spec_max_draft,
+                min_accept_ewma=spec.min_accept_ewma,
+            )
         self._router = StreamRouter(
             maxlen=self.serving_config.stream_buffer
         )
@@ -507,6 +525,11 @@ class InferenceServer:
         for i, (row, req) in enumerate(zip(rows, batch)):
             self._row_to_req[row] = req.request_id
             self._req_row[req.request_id] = row
+            if self.engine.spec_drafter is not None:
+                # tenant-scoped accept-rate EWMA: one tenant's
+                # unpredictable text degrades that tenant's drafting,
+                # not everyone's
+                self.engine.spec_drafter.set_tenant(row, req.tenant)
             if plans:
                 if plans[i].acquired:
                     self._acquired[req.request_id] = plans[i].acquired

@@ -96,7 +96,16 @@ def causal_dispatch(
         if attention_mask is not None
         else cache[0]["k"].shape[1]
     )
-    return combine_biases(causal_bias(q_len, kv_len, offset=cache_index), pad), False
+    offset = jnp.asarray(cache_index)
+    if offset.ndim == 2:
+        # [B, Q] per-column cache targets (the speculative verify step):
+        # the query window is consecutive from each row's first target,
+        # so the causal offset is the base column — rows whose window is
+        # parked at the OOB sentinel get an over-wide bias exactly like
+        # the one-token decode's idle-row ``capacity`` offset (their
+        # outputs are discarded; the padding bias still applies)
+        offset = offset[:, 0]
+    return combine_biases(causal_bias(q_len, kv_len, offset=offset), pad), False
 
 
 def dot_product_attention(

@@ -182,6 +182,20 @@ def concat_cols(a: jax.Array, b: jax.Array) -> jax.Array:
     return jax.lax.dynamic_update_slice(buf, b.astype(a.dtype), (0, a.shape[1]))
 
 
+def stack_cols(xs) -> jax.Array:
+    """Stack [B] columns into [B, len(xs)] via dynamic_update_slice
+    writes — NOT ``jnp.stack``, for the same SPMD mis-lowering reasons
+    as :func:`concat_cols` (the verify step's per-column outputs are
+    committed-sharded on the batch axis)."""
+    first = xs[0]
+    buf = jnp.zeros((first.shape[0], len(xs)), first.dtype)
+    for j, x in enumerate(xs):
+        buf = jax.lax.dynamic_update_slice(
+            buf, x.astype(first.dtype)[:, None], (0, j)
+        )
+    return buf
+
+
 def make_row_keys(phase_key: jax.Array, indices: jax.Array) -> jax.Array:
     """[N, 2] per-row base keys: ``fold_in(phase_key, index)`` per row.
 
@@ -225,7 +239,12 @@ def choose_tokens(
         filtered = filter_logits(choice_logits, gen_config)
         if row_keys is not None:
             B = logits_last.shape[0]
-            keys_t = jax.vmap(jax.random.fold_in)(
+            # the verify step calls this once per drafted column, so one
+            # `row_keys` lineage feeds D+1 fold_ins in a single program —
+            # each folds a DISTINCT step index t0+j (independent streams
+            # by the fold constant), which the key-reuse dataflow rule
+            # cannot prove from the jaxpr alone
+            keys_t = jax.vmap(jax.random.fold_in)(  # tpu-lint: disable=key-reuse
                 row_keys, jnp.broadcast_to(jnp.asarray(t, jnp.int32), (B,))
             )
             token = jax.vmap(
@@ -261,6 +280,82 @@ def choose_tokens(
             finished, n_real + jnp.asarray(t) + 1 >= gen_config.max_length
         )
     return token, live.astype(jnp.int32), logprob, value_out, finished
+
+
+def accept_drafts(
+    gen_config: GenerationConfig,
+    logits_seq: jax.Array,  # [B, D, V] f32: column j-1 = logits after the
+    #   anchor and the first j-1 draft tokens (predicts token t0 + j)
+    values_seq: jax.Array,  # [B, D] f32 value estimates at those columns
+    t0,  # [B] int32 decode step of the anchor token
+    finished: jax.Array,  # [B] bool AFTER the anchor (its finished_next)
+    accepted0: jax.Array,  # [B] bool — the anchor token was live
+    n_real,  # [B] real prompt lengths
+    draft: jax.Array,  # [B, D] int32 host-proposed tokens for t0+1..t0+D
+    draft_len: jax.Array,  # [B] int32 valid draft columns (0..D)
+    row_keys: jax.Array,  # [B, 2] per-row base keys
+    min_new=None,
+    budget: int = 0,  # R — tokens past it are never accepted
+):
+    """Longest-prefix draft acceptance — the speculative verify step's
+    token kernel (docs/inference.md "Speculative decoding").
+
+    Runs the EXACT one-token kernel (:func:`choose_tokens`, under the
+    same ``fold_in(row_key, t0+j)`` per-row keys) at every drafted
+    position and accepts draft ``j`` iff every earlier position was
+    accepted and the target sample equals the draft token. Because the
+    per-row RNG contract makes token ``t`` a pure function of
+    (row key, logits at ``t``) and the accepted prefix reproduces the
+    sequential loop's inputs position by position, accepted tokens are
+    bitwise the tokens the one-token loop would have sampled — rejection
+    never needs a rollback, only the refusal to accept what follows.
+
+    Unrolled over the (small, static) draft width D so every column is
+    literally a ``choose_tokens`` call — one parity surface, no scan
+    re-association. Returns ``(tokens, accepted, logprobs, values,
+    n_accepted, finished_next)`` with shapes [B, D] / [B]; ``accepted``
+    is a contiguous int32 prefix mask per row.
+    """
+    B, D = draft.shape[0], draft.shape[1]
+    acc_prev = jnp.asarray(accepted0, bool)
+    fin = finished
+    n_acc = jnp.zeros((B,), jnp.int32)
+    toks, accs, lps, vals = [], [], [], []
+    for j in range(1, D + 1):
+        token, live, logprob, value_out, fin_next = choose_tokens(
+            gen_config,
+            logits_seq[:, j - 1],
+            t0 + j,
+            fin,
+            values_seq[:, j - 1],
+            n_real,
+            min_new=min_new,
+            row_keys=row_keys,
+        )
+        ok = (
+            acc_prev
+            & (live == 1)
+            & (j <= draft_len)
+            & (token == draft[:, j - 1])
+            & (t0 + j < budget)
+        )
+        # finished advances only along the accepted prefix: a rejected
+        # position's eos (if any) is re-sampled by a later step
+        fin = jnp.where(ok, fin_next, fin)
+        n_acc = n_acc + ok.astype(jnp.int32)
+        acc_prev = ok
+        toks.append(token)
+        accs.append(ok.astype(jnp.int32))
+        lps.append(logprob)
+        vals.append(value_out)
+    return (
+        stack_cols(toks),
+        stack_cols(accs),
+        stack_cols(lps),
+        stack_cols(vals),
+        n_acc,
+        fin,
+    )
 
 
 def filter_logits(logits: jax.Array, cfg: GenerationConfig) -> jax.Array:

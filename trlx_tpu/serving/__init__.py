@@ -38,6 +38,10 @@ from trlx_tpu.serving.scheduler import (  # noqa: F401
     TokenBucket,
 )
 from trlx_tpu.serving.prefix_cache import PrefixBlockPool  # noqa: F401
+from trlx_tpu.serving.spec_drafter import (  # noqa: F401
+    NGramDrafter,
+    TrieDrafter,
+)
 from trlx_tpu.serving.streaming import (  # noqa: F401
     StreamRouter,
     TokenStream,
@@ -87,6 +91,7 @@ class ServingConfig:
 
 __all__ = [
     "DEFAULT_SLO_CLASSES",
+    "NGramDrafter",
     "PrefixBlockPool",
     "QoSScheduler",
     "Request",
@@ -96,4 +101,5 @@ __all__ = [
     "TenantConfig",
     "TokenBucket",
     "TokenStream",
+    "TrieDrafter",
 ]

@@ -245,6 +245,28 @@ class PrefixBlockPool:
                 )
             node.refcount -= 1
 
+    def ready_chains(self) -> List[List[int]]:
+        """Mask-filtered token sequences of every ready root-to-node
+        chain — the speculative drafter's global n-gram corpus
+        (``serving/spec_drafter.py``). A chain stops at the first
+        not-ready node (its bits are not readable, so its *content* is
+        not trustworthy as a draft source either)."""
+        out: List[List[int]] = []
+
+        def walk(node: _Node, prefix: List[int]) -> None:
+            if not node.ready:
+                return
+            ids, mask = node.key
+            toks = prefix + [int(t) for t, m in zip(ids, mask) if m]
+            if toks:
+                out.append(toks)
+            for child in node.children.values():
+                walk(child, toks)
+
+        for node in self._root.values():
+            walk(node, [])
+        return out
+
     def stats(self) -> Dict[str, float]:
         total = self.hits + self.misses
         return {

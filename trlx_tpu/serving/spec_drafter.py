@@ -1,0 +1,256 @@
+"""Host-side draft proposers for speculative decoding.
+
+The drafting half of the engine's drafted ``verify_step``
+(docs/inference.md "Speculative decoding"): a drafter proposes up to
+``max_draft`` next tokens per row from *host-visible* state only — no
+extra device forward, no draft model — and the jitted verify step
+accepts the longest prefix whose tokens bitwise-match what the target
+policy's ``choose_tokens`` samples under the per-row
+``fold_in(row_key, t)`` keys. A wrong draft therefore costs padded
+verify FLOPs, never correctness, which is what lets the drafters here
+be cheap heuristics:
+
+- :class:`NGramDrafter` — prompt-lookup decoding: the longest suffix of
+  a row's own history (prompt + committed emissions) that recurred
+  earlier in that same history predicts its previous continuation. Free,
+  per-row, and strong exactly where RLHF rollouts repeat themselves
+  (quotes from the prompt, templated spans).
+- :class:`TrieDrafter` — the n-gram fallback plus a *global* corpus: the
+  ready chains of the PR-13 shared-prefix radix trie
+  (:meth:`~trlx_tpu.serving.prefix_cache.PrefixBlockPool.ready_chains`).
+  Rows that diverged from a shared prefix early still draft from what
+  the fleet's other requests already published — the
+  "system-integrated" drafter shape of ROADMAP direction 2b.
+
+Accept-rate adaptivity: every proposer keeps a per-tenant EWMA of the
+verify step's accept fraction (rows map to tenants via
+:meth:`set_tenant`; unmapped rows are their own tenant). When the EWMA
+sinks below ``min_accept_ewma`` the drafter returns empty drafts for
+that tenant and the engine's ``_step_once`` falls through to plain
+one-token decode — graceful degrade, never an abort. The EWMA keeps
+updating from later verify outcomes only via fresh probes: after
+``DEGRADE_PROBE_EVERY`` suppressed draws the drafter emits one probe
+draft so a tenant whose text became predictable again can climb back
+out.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["NGramDrafter", "TrieDrafter"]
+
+# One probe draft per this many suppressed draws keeps a degraded
+# tenant's EWMA live (pure suppression would freeze it below the bar
+# forever).
+DEGRADE_PROBE_EVERY = 16
+
+
+class NGramDrafter:
+    """Per-row suffix n-gram self-lookup (prompt-lookup decoding).
+
+    :param max_draft: proposal cap per draw (the engine clamps its own
+        ``spec_max_draft`` the same way; the shorter wins).
+    :param max_ngram: longest suffix tried as the lookup needle; longer
+        matches win (tried first), down to ``min_ngram``.
+    :param min_ngram: shortest needle worth matching — 1-gram lookup is
+        near-noise on real vocabularies, so the default floor is 2.
+    :param min_accept_ewma: accept-rate floor; a tenant whose EWMA sinks
+        below it stops drafting (modulo probes). 0 never degrades.
+    :param ewma_alpha: EWMA step for each verify outcome.
+    """
+
+    def __init__(
+        self,
+        max_draft: int = 4,
+        max_ngram: int = 4,
+        min_ngram: int = 2,
+        min_accept_ewma: float = 0.0,
+        ewma_alpha: float = 0.2,
+    ):
+        if max_draft < 1:
+            raise ValueError(f"max_draft={max_draft} must be >= 1")
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"min_ngram={min_ngram} max_ngram={max_ngram}"
+            )
+        if not 0.0 <= min_accept_ewma <= 1.0:
+            raise ValueError(
+                f"min_accept_ewma={min_accept_ewma} must be in [0, 1]"
+            )
+        if not 0.0 < ewma_alpha <= 1.0:
+            raise ValueError(
+                f"ewma_alpha={ewma_alpha} must be in (0, 1]"
+            )
+        self.max_draft = int(max_draft)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self.min_accept_ewma = float(min_accept_ewma)
+        self.ewma_alpha = float(ewma_alpha)
+        self._hist: Dict[int, List[int]] = {}
+        self._tenant: Dict[int, str] = {}
+        # EWMA starts at 1.0: a fresh tenant drafts until evidence says
+        # otherwise (starting below the bar would deadlock degrade-off)
+        self._ewma: Dict[str, float] = {}
+        self._suppressed: Dict[str, int] = {}
+        self.drafts = 0
+        self.draft_hits = 0
+        self.degraded_draws = 0
+
+    # --------------------------- row lifecycle -------------------------- #
+
+    def observe_context(self, row: int, tokens: Sequence[int]) -> None:
+        """Seed a freshly admitted row's history with its (unpadded)
+        prompt tokens."""
+        self._hist[row] = [int(t) for t in tokens]
+
+    def observe_tokens(self, row: int, tokens: Sequence[int]) -> None:
+        """Append committed emissions (decode-tap or accepted verify
+        columns) to the row's history."""
+        self._hist.setdefault(row, []).extend(int(t) for t in tokens)
+
+    def observe_accept(
+        self, row: int, n_proposed: int, n_accepted: int
+    ) -> None:
+        """Fold one verify outcome into the row's tenant EWMA."""
+        if n_proposed < 1:
+            return
+        tenant = self._tenant.get(row, f"row:{row}")
+        rate = n_accepted / n_proposed
+        prev = self._ewma.get(tenant, 1.0)
+        self._ewma[tenant] = (
+            self.ewma_alpha * rate + (1.0 - self.ewma_alpha) * prev
+        )
+
+    def set_tenant(self, row: int, tenant: Optional[str]) -> None:
+        """Map a row to a tenant for accept-rate accounting (rows of
+        one tenant share text statistics; unmapped rows degrade
+        independently)."""
+        if tenant is None:
+            self._tenant.pop(row, None)
+        else:
+            self._tenant[row] = str(tenant)
+
+    def forget(self, row: int) -> None:
+        """Drop a harvested row's history (its slot is being reused)."""
+        self._hist.pop(row, None)
+        self._tenant.pop(row, None)
+
+    def reset(self) -> None:
+        """Drop all row state (phase boundary). Tenant EWMAs persist —
+        accept statistics are a property of the tenant's text, not of
+        one phase's slot assignments."""
+        self._hist.clear()
+        self._tenant.clear()
+
+    # ------------------------------ drafting ---------------------------- #
+
+    def accept_ewma(self, tenant: str) -> float:
+        return self._ewma.get(tenant, 1.0)
+
+    def _degraded(self, row: int) -> bool:
+        """True when this draw should be suppressed for accept-rate
+        degrade (counts a probe allowance so the EWMA stays live)."""
+        if self.min_accept_ewma <= 0.0:
+            return False
+        tenant = self._tenant.get(row, f"row:{row}")
+        if self._ewma.get(tenant, 1.0) >= self.min_accept_ewma:
+            self._suppressed.pop(tenant, None)
+            return False
+        n = self._suppressed.get(tenant, 0) + 1
+        if n >= DEGRADE_PROBE_EVERY:
+            self._suppressed[tenant] = 0
+            return False  # probe: one draft to refresh the EWMA
+        self._suppressed[tenant] = n
+        self.degraded_draws += 1
+        return True
+
+    def _lookup(
+        self, hist: Sequence[int], corpus: Sequence[int]
+    ) -> List[int]:
+        """Longest-suffix n-gram match of ``hist`` inside ``corpus``,
+        returning the continuation after the *most recent* match. When
+        ``corpus is hist`` the terminal occurrence (the needle itself)
+        is excluded."""
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if len(hist) < n:
+                continue
+            needle = list(hist[-n:])
+            limit = len(corpus) - n - (1 if corpus is hist else 0)
+            for i in range(limit, -1, -1):
+                if list(corpus[i : i + n]) == needle:
+                    cont = list(corpus[i + n : i + n + self.max_draft])
+                    if cont:
+                        return cont
+        return []
+
+    def draft(self, row: int) -> List[int]:
+        """Up to ``max_draft`` proposed next tokens for ``row`` ([] =
+        no proposal; the engine falls through to one-token decode)."""
+        if self._degraded(row):
+            return []
+        hist = self._hist.get(row)
+        if not hist:
+            return []
+        self.drafts += 1
+        out = self._lookup(hist, hist)
+        if out:
+            self.draft_hits += 1
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "spec_drafter/draws": float(self.drafts),
+            "spec_drafter/hits": float(self.draft_hits),
+            "spec_drafter/degraded_draws": float(self.degraded_draws),
+        }
+
+
+class TrieDrafter(NGramDrafter):
+    """N-gram drafting backed by the shared-prefix trie's published
+    chains as a global corpus, with the per-row self-lookup of
+    :class:`NGramDrafter` as first preference (a row's own history is
+    the best predictor of its own continuation; the trie catches rows
+    whose history hasn't repeated yet but whose prompt family has).
+
+    :param pool: the engine's :class:`PrefixBlockPool`; ``None`` keeps
+        pure n-gram behavior (the sharing-off serving build).
+    """
+
+    def __init__(self, pool=None, **kwargs):
+        super().__init__(**kwargs)
+        self.pool = pool
+        self.trie_hits = 0
+
+    def draft(self, row: int) -> List[int]:
+        if self._degraded(row):
+            return []
+        hist = self._hist.get(row)
+        if not hist:
+            return []
+        self.drafts += 1
+        out = self._lookup(hist, hist)
+        if out:
+            self.draft_hits += 1
+            return out
+        if self.pool is not None:
+            # chains extend their parents, so several ready chains can
+            # match the same suffix with continuations of different
+            # depth — the longest proposal wins (acceptance truncates
+            # at the first mismatch anyway; length costs nothing extra)
+            best: List[int] = []
+            for chain in self.pool.ready_chains():
+                cand = self._lookup(hist, chain)
+                if len(cand) > len(best):
+                    best = cand
+            if best:
+                self.draft_hits += 1
+                self.trie_hits += 1
+                return best
+        return []
+
+    def stats(self) -> Dict[str, float]:
+        out = super().stats()
+        out["spec_drafter/trie_hits"] = float(self.trie_hits)
+        return out

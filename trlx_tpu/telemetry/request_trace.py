@@ -220,6 +220,12 @@ def emit_request_trace(
         ]
     child("serve/prefill", admitted, first, **prefill_attrs)
     decode_attrs: Dict[str, Any] = {"tokens": int(tokens)}
+    if marks.get("spec_segments"):
+        # speculative verify steps committed > 1 token each: the
+        # cadence estimator must not read tokens > steps (or the wider
+        # per-step walls) as host bubbles
+        decode_attrs["spec_segments"] = int(marks["spec_segments"])
+        decode_attrs["accepted"] = int(marks.get("spec_accepted", 0))
     offsets: List[float] = []
     if step_times:
         offsets = [

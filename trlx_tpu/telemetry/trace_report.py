@@ -87,6 +87,8 @@ def build_requests(
         root = next((e for e in evs if e.get("name") == ROOT), None)
         stage_ms = {name: 0.0 for name in STAGES}
         step_offsets: List[float] = []
+        spec_segments = 0
+        spec_accepted = 0
         for e in evs:
             name = e.get("name", "")
             if name in stage_ms:
@@ -95,12 +97,17 @@ def build_requests(
                 step_offsets = [
                     float(x) for x in e["args"]["step_offsets_ms"]
                 ]
+            if name == "serve/decode" and "spec_segments" in e["args"]:
+                spec_segments = int(e["args"]["spec_segments"])
+                spec_accepted = int(e["args"].get("accepted", 0))
         view: Dict[str, Any] = {
             "trace_id": tid,
             "complete": root is not None,
             "stage_ms": {k: round(v, 3) for k, v in stage_ms.items()},
             "stage_sum_ms": round(sum(stage_ms.values()), 3),
             "step_offsets_ms": step_offsets,
+            "spec_segments": spec_segments,
+            "spec_accepted": spec_accepted,
         }
         if root is not None:
             args = root["args"]
@@ -169,10 +176,25 @@ def decode_bubbles(
     summed excess gap over that median — exactly zero on a gap-free
     trace (every gap == median), positive where the host loop stalled
     the cadence (admissions, harvests, GC, quota waits between pump
-    iterations)."""
+    iterations).
+
+    Requests whose ``serve/decode`` span carries ``spec_segments > 0``
+    ran speculative multi-token verify steps: their steps commit 1..D+1
+    tokens each through a wider program, so neither "uniform cadence"
+    nor "one token per step" holds and the excess-gap bound would read
+    the verify steps themselves as host bubbles. They are excluded from
+    both the median and the bubble rows and accounted explicitly
+    (``n_spec_excluded``/``spec_tokens_accepted``) — never silently."""
     all_gaps: List[float] = []
     per_req: List[Dict[str, Any]] = []
+    n_spec = 0
+    spec_accepted = 0
     for r in requests:
+        if r.get("spec_segments"):
+            n_spec += 1
+            spec_accepted += int(r.get("spec_accepted", 0))
+            per_req.append({"trace_id": r["trace_id"], "gaps": []})
+            continue
         offs = r.get("step_offsets_ms") or []
         gaps = [
             round(offs[i] - offs[i - 1], 3) for i in range(1, len(offs))
@@ -200,6 +222,8 @@ def decode_bubbles(
             sum(row["bubble_ms"] for row in rows), 3
         ),
         "requests": rows,
+        "n_spec_excluded": n_spec,
+        "spec_tokens_accepted": spec_accepted,
     }
 
 
@@ -315,4 +339,11 @@ def render_report(
         )
     else:
         lines.append("  no decode-cadence data (step offsets absent)")
+    if bubbles.get("n_spec_excluded"):
+        lines.append(
+            f"  {bubbles['n_spec_excluded']} request(s) excluded: "
+            "speculative verify steps commit multiple tokens per step "
+            f"({bubbles['spec_tokens_accepted']} draft tokens accepted) "
+            "— the uniform-cadence bound does not apply"
+        )
     return "\n".join(lines)

@@ -1248,6 +1248,8 @@ class PPOTrainer(BaseRLTrainer):
                         "pool; raise rollout.slots or actor_fraction"
                     )
 
+        spec = cfg.spec_decode
+        spec_on = spec is not None and spec.enabled
         return ContinuousBatchingEngine(
             apply_fn=apply_fn,
             init_cache_fn=functools.partial(
@@ -1267,6 +1269,13 @@ class PPOTrainer(BaseRLTrainer):
             with_values=True,
             prefill_chunk=cfg.prefill_chunk,
             prefill_chunks_per_pump=cfg.prefill_chunks_per_pump,
+            # the trainer path has no prefix pool, so rollout
+            # spec_decode.drafter: trie degrades to the per-row n-gram
+            # fallback (TrieDrafter with pool=None behaves identically)
+            spec_max_draft=spec.max_draft if spec_on else 0,
+            spec_min_accept_ewma=(
+                spec.min_accept_ewma if spec_on else 0.0
+            ),
         )
 
     # ------------------------------------------------------------------ #
