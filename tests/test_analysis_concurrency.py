@@ -1,0 +1,541 @@
+"""Engine 14: host-concurrency race auditor (``--races``).
+
+Static half: seeded/clean source pairs per rule, inline-suppression
+round-trips, and a clean-tree pin (the package must stay strict-clean).
+Dynamic half: schedule determinism (same seed => same decisions),
+yield-point coverage, planted-race localization + seed replay, the
+three real-code scenarios as tier-1 canaries, and regression pins
+proving the scheduler catches the exact bugs this PR fixed (the torn
+TokenStream close-vs-push handoff, the unlocked writer ``_error``
+swap's shape).
+"""
+
+import os
+import threading
+
+import pytest
+
+from trlx_tpu.analysis.concurrency import (
+    DeterministicScheduler,
+    SCENARIOS,
+    ScheduleViolation,
+    _plant_static,
+    _scenario_plant,
+    _scenario_stream,
+    _scenario_writer,
+    audit_races,
+    lint_races,
+    run_scenario,
+)
+from trlx_tpu.analysis.findings import filter_suppressed
+from trlx_tpu.utils import sched_points
+
+RULES = (
+    "unguarded-shared-write",
+    "lock-order-cycle",
+    "signal-unsafe-handler",
+    "atomicity-split",
+    "schedule-invariant-violation",
+)
+
+
+# --------------------------- registry ------------------------------ #
+
+
+def test_rules_registered():
+    from trlx_tpu.analysis.registry import all_rules
+
+    ids = {r.id for r in all_rules()}
+    for rule in RULES:
+        assert rule in ids
+    by_id = {r.id: r for r in all_rules()}
+    assert by_id["atomicity-split"].severity == "warning"
+    assert by_id["unguarded-shared-write"].severity == "error"
+    assert by_id["schedule-invariant-violation"].severity == "error"
+
+
+# ------------------------- static: per-rule pairs ------------------- #
+
+
+def _lint_source(tmp_path, source, name="mod.py"):
+    path = tmp_path / name
+    path.write_text(source)
+    return lint_races([str(path)]).findings
+
+
+RACY_SHARED_WRITE = """\
+import threading
+
+class Racy:
+    def __init__(self):
+        self.count = 0
+
+    def start(self):
+        for _ in range(2):
+            threading.Thread(target=self._work).start()
+
+    def _work(self):
+        self.count = self.count + 1
+"""
+
+CLEAN_SHARED_WRITE = """\
+import threading
+
+class Clean:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        for _ in range(2):
+            threading.Thread(target=self._work).start()
+
+    def _work(self):
+        with self._lock:
+            self.count = self.count + 1
+"""
+
+
+def test_unguarded_shared_write_pair(tmp_path):
+    racy = _lint_source(tmp_path, RACY_SHARED_WRITE, "racy.py")
+    assert any(
+        f.rule == "unguarded-shared-write" and f.subject == "Racy.count"
+        for f in racy
+    )
+    clean = _lint_source(tmp_path, CLEAN_SHARED_WRITE, "clean.py")
+    assert not [f for f in clean if f.rule == "unguarded-shared-write"]
+
+
+RACY_LOCK_ORDER = """\
+import threading
+
+class ABBA:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                self.x = 1
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                self.x = 2
+"""
+
+CLEAN_LOCK_ORDER = """\
+import threading
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                self.x = 1
+
+    def also_fwd(self):
+        with self._a:
+            with self._b:
+                self.x = 2
+"""
+
+
+def test_lock_order_cycle_pair(tmp_path):
+    racy = _lint_source(tmp_path, RACY_LOCK_ORDER, "abba.py")
+    assert any(f.rule == "lock-order-cycle" for f in racy)
+    clean = _lint_source(tmp_path, CLEAN_LOCK_ORDER, "ordered.py")
+    assert not [f for f in clean if f.rule == "lock-order-cycle"]
+
+
+RACY_HANDLER = """\
+import signal
+import sys
+
+class Guard:
+    def __init__(self):
+        self.flag = None
+        signal.signal(signal.SIGTERM, self._handler)
+
+    def _handler(self, signum, frame):
+        self.flag = signum
+        print("received", signum, file=sys.stderr)
+"""
+
+CLEAN_HANDLER = """\
+import signal
+
+class Guard:
+    def __init__(self):
+        self.flag = None
+        signal.signal(signal.SIGTERM, self._handler)
+
+    def _handler(self, signum, frame):
+        self.flag = signum
+"""
+
+
+def test_signal_unsafe_handler_pair(tmp_path):
+    racy = _lint_source(tmp_path, RACY_HANDLER, "handler.py")
+    hits = [f for f in racy if f.rule == "signal-unsafe-handler"]
+    assert hits and "print" in hits[0].message
+    clean = _lint_source(tmp_path, CLEAN_HANDLER, "flagonly.py")
+    assert not [f for f in clean if f.rule == "signal-unsafe-handler"]
+
+
+RACY_SPLIT = """\
+import threading
+
+class Split:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.closed = False
+        self.rows = []
+
+    def start(self):
+        threading.Thread(target=self._producer).start()
+
+    def _producer(self):
+        if not self.closed:
+            with self._lock:
+                self.rows.append(1)
+
+    def close(self):
+        with self._lock:
+            self.closed = True
+"""
+
+CLEAN_SPLIT = """\
+import threading
+
+class Joined:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.closed = False
+        self.rows = []
+
+    def start(self):
+        threading.Thread(target=self._producer).start()
+
+    def _producer(self):
+        with self._lock:
+            if not self.closed:
+                self.rows.append(1)
+
+    def close(self):
+        with self._lock:
+            self.closed = True
+"""
+
+
+def test_atomicity_split_pair(tmp_path):
+    racy = _lint_source(tmp_path, RACY_SPLIT, "split.py")
+    assert any(f.rule == "atomicity-split" for f in racy)
+    clean = _lint_source(tmp_path, CLEAN_SPLIT, "joined.py")
+    assert not [f for f in clean if f.rule == "atomicity-split"]
+
+
+def test_cross_object_closed_split(tmp_path):
+    # the exact pre-fix StreamRouter shape: caller checks closed, then
+    # pushes — two critical sections
+    src = (
+        "def on_tokens(stream, token):\n"
+        "    if not stream.closed:\n"
+        "        stream.push(token)\n"
+    )
+    findings = _lint_source(tmp_path, src, "router.py")
+    hits = [f for f in findings if f.rule == "atomicity-split"]
+    assert hits and "closed" in hits[0].message
+
+
+# ------------------------- suppression ----------------------------- #
+
+
+@pytest.mark.parametrize(
+    "rule, source",
+    [
+        ("unguarded-shared-write", RACY_SHARED_WRITE),
+        ("lock-order-cycle", RACY_LOCK_ORDER),
+        ("signal-unsafe-handler", RACY_HANDLER),
+        ("atomicity-split", RACY_SPLIT),
+    ],
+)
+def test_suppression_roundtrip(tmp_path, rule, source):
+    findings = _lint_source(tmp_path, source, "racy.py")
+    target = [f for f in findings if f.rule == rule]
+    assert target, f"seed for {rule} did not fire"
+    lines = source.splitlines()
+    # a rule can fire at several sites (lock-order-cycle names both
+    # acquisition orders); suppress every one
+    for line_no in sorted({f.line for f in target}):
+        lines[line_no - 1] += f"  # tpu-lint: disable={rule}"
+    suppressed_src = "\n".join(lines) + "\n"
+    findings2 = _lint_source(tmp_path, suppressed_src, "suppressed.py")
+    kept, n_suppressed = filter_suppressed(findings2)
+    assert not [f for f in kept if f.rule == rule]
+    assert n_suppressed >= 1
+
+
+# ------------------------- clean-tree pin --------------------------- #
+
+
+def test_package_static_clean():
+    """The shipped package must stay strict-clean under the lockset
+    walk (inline-suppressed findings excepted) — and the walk must
+    actually be looking at the concurrency-bearing modules."""
+    root = os.path.join(os.path.dirname(__file__), "..", "trlx_tpu")
+    result = lint_races([os.path.abspath(root)])
+    kept, _ = filter_suppressed(result.findings)
+    assert kept == [], [f.format_text() for f in kept]
+    basenames = {os.path.basename(f) for f in result.files}
+    assert {"async_writer.py", "streaming.py", "engine.py",
+            "preemption.py"} <= basenames
+    assert any("BackgroundJSONLWriter._run" in r for r in result.thread_roots)
+    assert any("PreemptionGuard._handler" in h for h in result.signal_handlers)
+
+
+# ------------------------- scheduler -------------------------------- #
+
+
+def test_same_seed_same_schedule(tmp_path):
+    os.makedirs(tmp_path / "a")
+    s1 = DeterministicScheduler(3)
+    _scenario_writer(s1, str(tmp_path / "a"))
+    os.makedirs(tmp_path / "b")
+    s2 = DeterministicScheduler(3)
+    _scenario_writer(s2, str(tmp_path / "b"))
+    assert s1.decisions == s2.decisions
+    assert s1.trace == s2.trace
+    os.makedirs(tmp_path / "c")
+    s3 = DeterministicScheduler(4)
+    _scenario_writer(s3, str(tmp_path / "c"))
+    assert s1.decisions != s3.decisions
+
+
+def test_yield_point_coverage(tmp_path):
+    """The instrumented production paths must actually hit their yield
+    points — a silently-uninstrumented path would explore nothing."""
+    sched = DeterministicScheduler(0)
+    _scenario_writer(sched, str(tmp_path))
+    for tag in ("writer.enqueue", "writer.loop", "writer.lock",
+                "writer.append"):
+        assert sched.yield_counts[tag] > 0, sched.yield_counts
+    sched2 = DeterministicScheduler(0)
+    _scenario_stream(sched2, str(tmp_path))
+    for tag in ("stream.push", "stream.next", "stream.close"):
+        assert sched2.yield_counts[tag] > 0, sched2.yield_counts
+
+
+def test_hook_always_uninstalled(tmp_path):
+    assert not sched_points.instrumented()
+    sched = DeterministicScheduler(0)
+    _scenario_writer(sched, str(tmp_path))
+    assert not sched_points.instrumented()
+    # even when a scheduled thread raises
+    sched2 = DeterministicScheduler(1)
+
+    def fn():
+        sched_points.yield_point("boom")
+        raise ScheduleViolation("synthetic")
+
+    sched2.spawn("boomer", fn)
+    with pytest.raises(ScheduleViolation):
+        sched2.run()
+    assert not sched_points.instrumented()
+
+
+# ------------------------- planted race ----------------------------- #
+
+
+def test_planted_race_localizes_and_replays():
+    sr = run_scenario("planted-counter", 64, fn=_scenario_plant)
+    assert not sr.passed
+    assert sr.violating_seed is not None
+    assert "lost update" in sr.violation
+    # replaying EXACTLY that seed reproduces the violation
+    replay = run_scenario(
+        "planted-counter", 1, seed_base=sr.violating_seed,
+        fn=_scenario_plant,
+    )
+    assert not replay.passed
+    assert replay.violating_seed == sr.violating_seed
+
+
+def test_planted_static_fires(tmp_path):
+    findings, path = _plant_static(str(tmp_path))
+    hits = [f for f in findings if f.rule == "unguarded-shared-write"]
+    assert hits
+    assert hits[0].file == path
+    assert hits[0].subject == "PlantedCounter.count"
+
+
+# -------------------- regression pins (the PR's fixes) --------------- #
+
+
+class _TornStream:
+    """Pre-fix TokenStream shape: no lock, the consumer checks `closed`
+    and the buffer in two separate looks — the scheduler must be able
+    to interleave a push between them and strand the token."""
+
+    def __init__(self):
+        self.buf = []
+        self.closed = False
+
+    def push(self, tok):
+        sched_points.yield_point("torn.push")
+        if self.closed:
+            return False
+        sched_points.yield_point("torn.push.append")
+        self.buf.append(tok)
+        return True
+
+    def close(self):
+        sched_points.yield_point("torn.close")
+        self.closed = True
+
+    def consume_all(self):
+        out = []
+        while True:
+            sched_points.yield_point("torn.next")
+            if self.buf:
+                out.append(self.buf.pop(0))
+                continue
+            # the pre-fix bug: buf-empty and closed are two separate
+            # looks — a push+close can land between them
+            sched_points.yield_point("torn.check_closed")
+            if self.closed:
+                return out
+
+
+def _torn_scenario(sched, workdir):
+    stream = _TornStream()
+    accepted = []
+    consumed = []
+
+    def producer():
+        for tok in range(4):
+            if stream.push(tok):
+                accepted.append(tok)
+        stream.close()
+
+    def consumer():
+        consumed.extend(stream.consume_all())
+
+    sched.spawn("producer", producer)
+    sched.spawn("consumer", consumer)
+    sched.run()
+    if consumed != accepted:
+        raise ScheduleViolation(
+            f"torn: accepted {accepted} consumed {consumed}"
+        )
+
+
+def test_scheduler_catches_torn_stream():
+    """The unlocked close-vs-push replica MUST violate under some seed
+    (this is what the fixed TokenStream's lock prevents — see
+    test_stream_scenario_canary for the fixed path staying green)."""
+    sr = run_scenario("torn-stream", 64, fn=_torn_scenario)
+    assert not sr.passed, "scheduler failed to find the torn handoff"
+    assert "torn" in sr.violation
+
+
+def test_fixed_stream_accounting_exact(tmp_path):
+    """Post-fix invariant, directly: accepted + dropped_after_close
+    covers every push, under real threads (no scheduler)."""
+    from trlx_tpu.serving.streaming import TokenStream
+
+    stream = TokenStream(1, maxlen=64, pump=lambda: True)
+    accepted = []
+    consumed = []
+
+    def producer():
+        for tok in range(50):
+            if stream.push(tok):
+                accepted.append(tok)
+        stream.close()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    for tok in stream:
+        consumed.append(tok)
+    t.join()
+    assert consumed == accepted
+    assert len(accepted) + stream.dropped_after_close == 50
+
+
+# ------------------------- scenario canaries ------------------------- #
+
+
+def test_writer_scenario_canary():
+    sr = run_scenario("writer-rows", 3)
+    assert sr.passed, sr.violation
+
+
+def test_stream_scenario_canary():
+    sr = run_scenario("stream-close", 3)
+    assert sr.passed, sr.violation
+
+
+def test_engine_scenario_canary():
+    # one even seed (W=0 bitwise-parity leg) + one odd (free-push leg);
+    # the tiny engine is lru_cached so the compile is paid once
+    sr = run_scenario("engine-push", 2)
+    assert sr.passed, sr.violation
+    assert sr.yield_tags.get("engine.safe_point", 0) > 0
+    assert sr.yield_tags.get("engine.push_lock", 0) > 0
+
+
+# ------------------------- report plumbing --------------------------- #
+
+
+def test_audit_report_plumbing(tmp_path):
+    """audit_races wires findings/covered/suppression through the
+    shared Report: scope the static half to a tiny tree and run one
+    cheap scenario."""
+    (tmp_path / "mod.py").write_text(RACY_SHARED_WRITE)
+    report, result = audit_races(
+        paths=[str(tmp_path)], schedules=1,
+        scenarios=["stream-close"],
+    )
+    assert any(
+        f.rule == "unguarded-shared-write" for f in report.findings
+    )
+    assert report.exit_code(strict=False) == 1
+    assert any(c.startswith("schedule:stream-close") for c in report.covered)
+    assert any(c.startswith("class:") for c in report.covered)
+    names = [s.name for s in result.scenarios]
+    assert names == ["stream-close"]
+
+
+def test_audit_plant_names_both(tmp_path):
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    report, result = audit_races(
+        paths=[str(tmp_path)], schedules=2, plant=True,
+        scenarios=["planted-counter"],
+    )
+    rules = {f.rule for f in report.findings}
+    assert "unguarded-shared-write" in rules
+    assert "schedule-invariant-violation" in rules
+    assert report.exit_code(strict=False) == 1
+    sched_f = [
+        f for f in report.findings
+        if f.rule == "schedule-invariant-violation"
+    ]
+    assert "--race-seed" in sched_f[0].message
+
+
+# ------------------------- nightly full sweep ------------------------ #
+
+
+@pytest.mark.slow  # full interleaving sweep: nightly tier
+def test_full_schedule_sweep():
+    for name, _fn in SCENARIOS:
+        sr = run_scenario(name, 24)
+        assert sr.passed, f"{name}: {sr.violation}"
+        assert sr.schedules == 24

@@ -51,6 +51,16 @@ safety properties the fsdp/tp NaN divergence exposed:
   host-concurrency rules in ``ast_lint`` (``rank-gated-dispatch``,
   ``nondet-host-order``, ``host-time-in-dispatch``,
   ``unsynced-host-io``), run by ``--engine all``/``ast``.
+- :mod:`trlx_tpu.analysis.concurrency` — ``--races`` audits the host
+  threads themselves (engine 14): a whole-repo thread-entry-point
+  inventory + attribute-level lockset walk (rules
+  ``unguarded-shared-write``, ``lock-order-cycle``,
+  ``signal-unsafe-handler``, ``atomicity-split``, with a curated
+  single-thread-contract allowlist), then a deterministic cooperative
+  scheduler running the REAL async-writer / engine weight-push /
+  TokenStream paths under N seeded interleavings (rule
+  ``schedule-invariant-violation`` reports the first violating
+  schedule as a replayable ``--race-seed``).
 
 Run ``python -m trlx_tpu.analysis --help`` or see docs/static_analysis.md.
 """

@@ -11,8 +11,10 @@ peak-HBM / collective / FLOP budgets per traced program), ``--compile-
 audit`` (runtime compile counting), ``--perf-audit`` (measured
 per-span wall-clock over the instrumented phase loop), and
 ``--lockstep`` (N simulated controller processes diffing per-host
-dispatch logs), and ``--hlo-audit`` (AOT-compiled post-SPMD HLO vs
-jaxpr intent) — the budgeted modes gated against the committed
+dispatch logs), ``--hlo-audit`` (AOT-compiled post-SPMD HLO vs
+jaxpr intent), and ``--races`` (host-concurrency lockset lint +
+deterministic-schedule interleaving engine) — the budgeted modes gated
+against the committed
 ``analysis/budgets.json`` with ``--update-budgets`` relocking each
 engine's own section. JSON output
 carries a top-level ``schema_version`` and deterministic ordering so CI
@@ -99,6 +101,49 @@ def main(argv=None) -> int:
         help="with --lockstep: plant one rank-0-only dispatch at the end "
         "of the loop — self-check that the simulator localizes exactly "
         "this hazard (budget gating is skipped; exit must be 1)",
+    )
+    parser.add_argument(
+        "--races",
+        action="store_true",
+        help="instead of the rule engines: host-concurrency race audit — "
+        "static thread-entry-point inventory + attribute-level lockset "
+        "walk (unguarded-shared-write, lock-order-cycle, "
+        "signal-unsafe-handler, atomicity-split), then a deterministic "
+        "cooperative scheduler running the real async-writer, engine "
+        "drive/weight-push, and TokenStream paths under N seeded "
+        "interleavings asserting the repo's cross-thread invariants "
+        "(schedule-invariant-violation names the replayable seed)",
+    )
+    parser.add_argument(
+        "--schedules",
+        type=int,
+        default=6,
+        help="with --races: seeded interleavings explored per scenario "
+        "(default 6; nightly sweeps pass more)",
+    )
+    parser.add_argument(
+        "--race-seed",
+        type=int,
+        default=None,
+        metavar="SEED",
+        help="with --races: replay exactly this one schedule seed per "
+        "scenario instead of the 0..N-1 sweep (reproduce a reported "
+        "schedule-invariant-violation)",
+    )
+    parser.add_argument(
+        "--race-scenarios",
+        metavar="NAMES",
+        default=None,
+        help="with --races: comma-separated subset of dynamic scenarios "
+        "(writer-rows,stream-close,engine-push; default: all)",
+    )
+    parser.add_argument(
+        "--plant-race",
+        action="store_true",
+        help="with --races: plant a deliberate unguarded counter through "
+        "BOTH halves — the lockset walk must name "
+        "unguarded-shared-write at the planted file:line and the "
+        "scheduler must find a violating schedule; exit must be 1",
     )
     parser.add_argument(
         "--hlo-audit",
@@ -361,6 +406,33 @@ def main(argv=None) -> int:
             # on the tree, or a cross-mesh partial relock) and nothing
             # was written
             return 1 if report.findings else 0
+        return report.exit_code(strict=args.strict)
+
+    if args.races or args.plant_race:
+        _force_cpu_platform()
+        from trlx_tpu.analysis.concurrency import (
+            audit_races,
+            format_races_text,
+        )
+
+        scenarios = (
+            [s.strip() for s in args.race_scenarios.split(",") if s.strip()]
+            if args.race_scenarios
+            else None
+        )
+        report, result = audit_races(
+            paths=args.paths,
+            schedules=args.schedules,
+            plant=args.plant_race,
+            seed=args.race_seed,
+            scenarios=scenarios,
+        )
+        if args.json:
+            print(report.to_json())
+        else:
+            print(format_races_text(result))
+            if report.findings:
+                print(report.format_text())
         return report.exit_code(strict=args.strict)
 
     if args.lockstep:

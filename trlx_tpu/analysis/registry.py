@@ -26,6 +26,7 @@ ENGINE_PRNG = "prng"
 ENGINE_PERF = "perf"
 ENGINE_LOCKSTEP = "lockstep"
 ENGINE_HLO = "hlo"
+ENGINE_CONCURRENCY = "concurrency"
 
 
 @dataclass(frozen=True)
@@ -478,6 +479,72 @@ register_rule(Rule(
     "difference re-hashes the jit cache key or mismatches the "
     "collective's operands across hosts. Read on rank 0 and broadcast, "
     "or route through the checkpoint layer's synchronized restore.",
+))
+
+# ------------------- host-concurrency races (engine 14) ------------------ #
+
+register_rule(Rule(
+    "unguarded-shared-write",
+    ENGINE_CONCURRENCY,
+    "every attribute mutated from two or more thread roots is guarded by "
+    "a common lock on every mutation path (or the owning class carries a "
+    "written single-thread contract)",
+    SEVERITY_ERROR,
+    "The host side is concurrent now — writer thread, drive loop, "
+    "weight-push caller, stream pump, signal handlers — and a shared "
+    "counter or reference mutated from two roots without one lock is a "
+    "data race: torn under free-threading, and a lost update even under "
+    "the GIL when the mutation is a read-modify-write.",
+))
+register_rule(Rule(
+    "lock-order-cycle",
+    ENGINE_CONCURRENCY,
+    "the discovered locks are acquired in one consistent global order "
+    "(no path acquires A then B while another acquires B then A)",
+    SEVERITY_ERROR,
+    "Inconsistent acquisition order is the classic ABBA deadlock: each "
+    "thread holds one lock and blocks forever on the other. The cycle "
+    "only bites under load on real hardware, where it presents as a "
+    "hung slice, not a stack trace.",
+))
+register_rule(Rule(
+    "signal-unsafe-handler",
+    ENGINE_CONCURRENCY,
+    "SIGTERM/SIGINT handlers do nothing beyond async-signal-safe flag "
+    "sets (one attribute/global assignment; no I/O, no allocation-heavy "
+    "calls, no locks)",
+    SEVERITY_ERROR,
+    "A Python signal handler runs between arbitrary bytecodes of the "
+    "interrupted thread. print() there can deadlock on the stdout "
+    "buffer lock the main thread already holds; anything beyond "
+    "setting a flag races the drain that the preemption contract says "
+    "happens at phase boundaries.",
+))
+register_rule(Rule(
+    "atomicity-split",
+    ENGINE_CONCURRENCY,
+    "no check-then-act on shared state outside the lock that guards "
+    "that state (the check and the act must sit in one critical "
+    "section)",
+    SEVERITY_WARNING,
+    "`if not stream.closed: stream.push(tok)` is two critical sections: "
+    "a close between them loses the token even though both halves are "
+    "individually locked. TOCTOU on shared state is invisible to "
+    "single-schedule tests — every parity pin in the suite runs one "
+    "lucky interleaving.",
+))
+register_rule(Rule(
+    "schedule-invariant-violation",
+    ENGINE_CONCURRENCY,
+    "the repo's claimed concurrency invariants (version-column "
+    "monotonicity, no torn stream rows, staleness_window=0 bitwise "
+    "parity, zero lost writer rows) hold under every explored "
+    "deterministic thread interleaving",
+    SEVERITY_ERROR,
+    "Static locksets prove guarding, not semantics. The cooperative "
+    "scheduler runs the REAL writer/drive/push/pump code under seeded "
+    "interleavings and replays the first violating schedule by seed — "
+    "a race gate the 13 jaxpr/HLO-level engines cannot provide.",
 ))
 
 # ---------------------------- AST-lint rules ----------------------------- #

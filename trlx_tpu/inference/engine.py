@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import dataclasses
 import inspect
+import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import flax.struct as struct
@@ -82,6 +83,7 @@ from trlx_tpu.ops.sampling import (
     concat_cols,
     make_row_keys,
 )
+from trlx_tpu.utils import sched_points
 
 
 @struct.dataclass
@@ -108,7 +110,13 @@ class EngineState:
 
 @dataclasses.dataclass
 class EngineStats:
-    """Host-side occupancy/throughput counters for one phase."""
+    """Host-side occupancy/throughput counters for one phase.
+
+    Single-thread contract (engine 14 allowlist): every counter is
+    mutated only by the thread running the drive/pump loop; the metrics
+    absorber and phase summaries read them at phase boundaries, after
+    drive() returned on that same thread. No lock — cross-thread traffic
+    into the engine goes through push_weights (the one locked entry)."""
 
     admitted: int = 0
     completed: int = 0
@@ -453,8 +461,14 @@ class ContinuousBatchingEngine:
         # stages a refresh that the drive loop applies at its safe point
         self.param_version = 0
         self._slot_versions = np.zeros(self.num_slots, np.int64)
-        self._pending_params = None
-        self._pending_version: Optional[int] = None
+        # staged (params, version) swapped as ONE reference under
+        # _push_lock: push_weights arrives from the learner thread while
+        # the drive thread's safe point applies it and
+        # min_inflight_version reads it — staging two separate fields
+        # can be observed torn (new params, old version tag), which
+        # mis-tags every row admitted before the safe point
+        self._pending_push: Optional[Tuple[Any, int]] = None
+        self._push_lock = threading.Lock()
         self._steps_since_poll = 0
         #: host callback fired with the admitted rows' indices right
         #: after each prefill dispatch — the serving tier marks newly
@@ -1271,8 +1285,8 @@ class ContinuousBatchingEngine:
         self._next_row = row_start
         self.param_version = 0
         self._slot_versions[:] = 0
-        self._pending_params = None
-        self._pending_version = None
+        with sched_points.guard(self._push_lock, "engine.push_lock"):
+            self._pending_push = None
         self._steps_since_poll = 0
         self.stats = EngineStats(num_slots=self.num_slots)
         self._req_times = {}
@@ -1295,19 +1309,27 @@ class ContinuousBatchingEngine:
         ``params`` must own its buffers (the learner's masters are
         donated by every train step — push a snapshot/copy, not the
         live tree). Consecutive pushes before the next safe point
-        coalesce: only the newest params are ever applied."""
-        self._pending_params = params
-        self._pending_version = (
-            int(version) if version is not None else self.param_version + 1
-        )
+        coalesce: only the newest params are ever applied.
+
+        This is the engine's only cross-thread entry point: the staged
+        (params, version) pair is one reference written under
+        ``_push_lock`` so the drive thread can never observe new params
+        with an old version tag."""
+        sched_points.yield_point("engine.push")
+        with sched_points.guard(self._push_lock, "engine.push_lock"):
+            self._pending_push = (
+                params,
+                int(version) if version is not None
+                else self.param_version + 1,
+            )
 
     def _apply_pending_push(self) -> None:
-        if self._pending_params is None:
-            return
-        self._params = self._pending_params
-        self.param_version = self._pending_version
-        self._pending_params = None
-        self._pending_version = None
+        sched_points.yield_point("engine.safe_point")
+        with sched_points.guard(self._push_lock, "engine.push_lock"):
+            staged, self._pending_push = self._pending_push, None
+            if staged is None:
+                return
+            self._params, self.param_version = staged
         # a weight push invalidates outstanding speculative drafts: the
         # next verify step's targets come from the refreshed params, so
         # prefetched proposals re-draft at the next step (drafts are
@@ -1324,15 +1346,16 @@ class ContinuousBatchingEngine:
         admitted under (the current one, or a staged push's). ``None``
         when nothing is in flight (the bounded-staleness guard is then
         vacuous)."""
+        # the staged pair and the current version are read under the
+        # push lock so a concurrent push_weights cannot be seen torn
+        with sched_points.guard(self._push_lock, "engine.push_lock"):
+            staged = self._pending_push
+            current = self.param_version
         # _busy_rows covers decoding AND done-awaiting-harvest slots
         # (slots leave it only at harvest), so one pass covers both
         versions = [int(self._slot_versions[s]) for s in self._busy_rows]
         if self._queue:
-            versions.append(
-                self._pending_version
-                if self._pending_params is not None
-                else self.param_version
-            )
+            versions.append(staged[1] if staged is not None else current)
         return min(versions) if versions else None
 
     def submit(
@@ -1920,6 +1943,7 @@ class ContinuousBatchingEngine:
         yielded = 0
         self._steps_since_poll = 0
         while yielded < target:
+            sched_points.yield_point("engine.drive")
             for group in self._harvest_ready():
                 yield group
                 yielded += len(group["rows"])

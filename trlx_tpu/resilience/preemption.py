@@ -59,17 +59,28 @@ class PreemptionGuard:
             self._signums.append(num)
         self._previous: Dict[int, object] = {}
         self._received: Optional[int] = None
+        self._announced = False
         self._installed = False
 
     # ----------------------------- handlers ---------------------------- #
 
     def _handler(self, signum, frame) -> None:
-        first = self._received is None
+        # Async-signal-safe contract (engine 14, signal-unsafe-handler):
+        # the handler runs between arbitrary bytecodes of the interrupted
+        # thread, so it does EXACTLY one flag assignment — no print (the
+        # interrupted thread may hold the stderr buffer lock), no
+        # Signals() enum construction, no allocation-heavy calls. The
+        # one-time announcement happens at the poll site instead.
         self._received = signum
-        if first:
+
+    def _announce(self) -> None:
+        """One-time stderr note, emitted from normal (poll-site) code —
+        never from inside the handler."""
+        if self._received is not None and not self._announced:
+            self._announced = True
             print(
-                f"resilience: received {signal.Signals(signum).name} — "
-                "will drain at the next phase boundary (emergency "
+                f"resilience: received {signal.Signals(self._received).name}"
+                " — will drain at the next phase boundary (emergency "
                 "checkpoint + flight dump)",
                 file=sys.stderr,
             )
@@ -100,10 +111,12 @@ class PreemptionGuard:
         self._installed = False
 
     def requested(self) -> bool:
+        self._announce()
         return self._received is not None
 
     def clear(self) -> None:
         self._received = None
+        self._announced = False
 
     @property
     def received_signal(self) -> Optional[str]:

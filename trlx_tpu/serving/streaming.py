@@ -6,22 +6,28 @@ live) vectors; :class:`StreamRouter` fans them out into per-request
 decouples from harvest-group completion (the ``serve/ttft_ms``
 histogram measures the difference; docs/serving.md "Streaming").
 
-Single-process contract: the serving loop and the consumer interleave
-on one thread (the iterator *pumps the engine* when its queue is
-empty), so a ``stream=True`` submit works without any background
-machinery. The queues are still thread-safe deques, so a
-driver-thread + consumer-thread deployment works unchanged — a full
-queue drops the OLDEST buffered token and counts the overflow
-(``overflows`` on the stream), never blocks the decode loop.
+Host-concurrency contract (engine 14, docs/static_analysis.md): the
+single-process serving loop interleaves producer and consumer on one
+thread, but a driver-thread + consumer-thread deployment is supported —
+so every buffer/flag touch happens under ``TokenStream._lock``. The
+close-vs-push handoff is the canonical ``atomicity-split``: ``push``
+decides closed-ness and buffers IN ONE critical section (a push racing a
+close either lands before it or is dropped and counted, never torn), and
+``__next__`` checks buffer-empty and closed under the same lock, so a
+token pushed before ``close()`` can never be swallowed by a
+``StopIteration``. A full queue drops the OLDEST buffered token and
+counts the overflow (``overflows``), never blocks the decode loop.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Callable, Dict, Iterator, List, Optional
 
 from trlx_tpu.telemetry.tracer import monotonic
+from trlx_tpu.utils import sched_points
 
 
 class TokenStream:
@@ -42,8 +48,13 @@ class TokenStream:
         self.request_id = request_id
         self._buf: "deque[int]" = deque(maxlen=max(1, int(maxlen)))
         self._pump = pump
+        # guards every shared field below: producer (push/close from the
+        # driver or serving loop) and consumer (__next__/drain) may live
+        # on different threads
+        self._lock = threading.Lock()
         self.closed = False
         self.overflows = 0  # tokens dropped oldest-first on a full queue
+        self.dropped_after_close = 0  # pushes that lost the race to close
         self.emitted = 0
         # stream-delivery trace marks (telemetry/request_trace.py): when
         # the first token reached this queue and when the stream closed
@@ -51,31 +62,51 @@ class TokenStream:
         self.first_push_at: Optional[float] = None
         self.closed_at: Optional[float] = None
 
-    def push(self, token: int) -> None:
-        if len(self._buf) == self._buf.maxlen:
-            self.overflows += 1
-        self._buf.append(int(token))
-        self.emitted += 1
-        if self.first_push_at is None:
-            self.first_push_at = monotonic()
+    def push(self, token: int) -> bool:
+        """Buffer one token; returns False (token dropped + counted) when
+        the stream already closed — closed-ness is decided under the same
+        lock as the buffering, so a racing close never tears the pair."""
+        sched_points.yield_point("stream.push")
+        with self._lock:
+            if self.closed:
+                self.dropped_after_close += 1
+                return False
+            if len(self._buf) == self._buf.maxlen:
+                self.overflows += 1
+            self._buf.append(int(token))
+            self.emitted += 1
+            if self.first_push_at is None:
+                self.first_push_at = monotonic()
+            return True
 
     def close(self) -> None:
-        if not self.closed:
-            self.closed_at = monotonic()
-        self.closed = True
+        sched_points.yield_point("stream.close")
+        with self._lock:
+            if not self.closed:
+                self.closed_at = monotonic()
+            self.closed = True
 
     def __iter__(self) -> Iterator[int]:
         return self
 
     def __next__(self) -> int:
         while True:
-            if self._buf:
-                return self._buf.popleft()
-            if self.closed:
-                raise StopIteration
+            sched_points.yield_point("stream.next")
+            with self._lock:
+                if self._buf:
+                    return self._buf.popleft()
+                # empty AND closed observed atomically: any token pushed
+                # before the close is in the buffer (push holds the same
+                # lock), so stopping here cannot lose one
+                if self.closed:
+                    raise StopIteration
             if self._pump is None:
                 raise StopIteration
             if not self._pump():
+                if sched_points.instrumented():
+                    # the cooperative scheduler serializes progress; a
+                    # real sleep would stall the whole schedule
+                    continue
                 # no progress (e.g. this request is quota-throttled and
                 # nothing is decoding): yield the CPU while the bucket
                 # refills instead of busy-spinning the serving loop
@@ -83,14 +114,21 @@ class TokenStream:
 
     def drain(self) -> List[int]:
         """Everything currently buffered, without pumping."""
-        out = list(self._buf)
-        self._buf.clear()
+        with self._lock:
+            out = list(self._buf)
+            self._buf.clear()
         return out
 
 
 class StreamRouter:
     """Row-index → :class:`TokenStream` fan-out; the engine's
-    ``token_sink``."""
+    ``token_sink``.
+
+    Single-thread contract: the routing table itself (``_streams``) is
+    mutated only by the serving loop (attach/close/pop happen at submit
+    and harvest, on the loop thread); cross-thread traffic goes through
+    the per-stream lock inside :class:`TokenStream`.
+    """
 
     def __init__(self, maxlen: int = 1024):
         self.maxlen = int(maxlen)
@@ -112,10 +150,13 @@ class StreamRouter:
 
     def on_tokens(self, emitted: Dict[int, int]) -> None:
         """Engine token-sink callback: ``{row: token}`` for this decode
-        step's live emissions."""
+        step's live emissions. Closed-ness is decided inside
+        :meth:`TokenStream.push` (one critical section) — checking
+        ``stream.closed`` here first would re-open the check-then-act
+        window the per-stream lock exists to close."""
         for row, token in emitted.items():
             stream = self._streams.get(row)
-            if stream is not None and not stream.closed:
+            if stream is not None:
                 stream.push(token)
 
     def close(self, row: int) -> None:

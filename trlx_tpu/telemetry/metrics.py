@@ -28,6 +28,17 @@ allocated, nothing recorded). Rank-0 gating follows the tracer's
 (``TRLX_TELEMETRY`` overrides; multi-host pods meter the main process
 only).
 
+Single-thread contract (engine 14 allowlist,
+``analysis/concurrency.py``): the instrument TABLE is guarded by the
+registry's ``_lock`` (creation may race), but the instruments
+themselves are a rank-0 **main-thread** namespace — mutated and
+snapshot from the trainer's host loop (the engine's drive thread and
+the serving pump run on that same loop). Nothing here is safe to
+mutate from the background writer thread or a learner-pusher thread;
+cross-thread code must hand values to the host loop and let it record
+them. The ``--races`` lockset walk encodes this by allowlisting the
+class instead of demanding a lock on the per-mutation hot paths.
+
 Module is stdlib-only at import time (the clock comes from
 :mod:`trlx_tpu.telemetry.tracer`, itself stdlib-only).
 """

@@ -42,6 +42,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from trlx_tpu.resilience import chaos
+from trlx_tpu.utils import sched_points
 from trlx_tpu.utils.retry import classify_io_error
 
 #: buffered-batch cap: past this, unwritable rows become a hard error
@@ -79,14 +80,27 @@ class BackgroundJSONLWriter:
             raise RuntimeError("writer is closed")
         self._raise_pending()
         lines = [json.dumps(r) for r in rows]
-        if self._degraded:
+        # _degraded is a monotone latch (False->True, never back): a stale
+        # False here just enqueues one more batch, which the draining
+        # thread still writes in order — no torn state is reachable, so
+        # the check may stay outside _lock
+        if self._degraded:  # tpu-lint: disable=atomicity-split
             # degraded mode: write in the caller, after the queue's
             # remaining batches drain (ordering per path is preserved)
-            if self._thread is not None:
-                self._q.join()
+            self._join_queue()
             self._write_buffered(then=(path, lines))
             return
         self._ensure_thread()
+        sched_points.yield_point("writer.enqueue")
+        if sched_points.instrumented():
+            # cooperative scheduler: a blocking put on a full queue would
+            # stall the whole schedule; poll-and-yield instead
+            while True:
+                try:
+                    self._q.put_nowait((path, lines))
+                    return
+                except queue.Full:
+                    sched_points.yield_point("writer.enqueue.full")
         self._q.put((path, lines))
 
     @property
@@ -102,8 +116,8 @@ class BackgroundJSONLWriter:
         synchronous attempt here; still-failing ones stay buffered (the
         degradation contract: a momentarily-full disk must not kill the
         phase) and become a hard error only at :meth:`close`."""
-        if self._thread is not None:
-            self._q.join()
+        sched_points.yield_point("writer.flush")
+        self._join_queue()
         self._write_buffered()
         if reraise:
             self._raise_pending()
@@ -121,6 +135,11 @@ class BackgroundJSONLWriter:
         self._closed = True
         if self._thread is not None:
             self._q.put(None)
+            if sched_points.instrumented():
+                # let the scheduler drive the writer thread to its exit
+                # instead of blocking the schedule inside join()
+                while self._thread.is_alive():
+                    sched_points.yield_point("writer.close.join")
             self._thread.join(timeout=10)
             self._thread = None
         self._write_buffered()  # last chance for transient-buffered rows
@@ -141,22 +160,44 @@ class BackgroundJSONLWriter:
     # ---------------------------- internal ---------------------------- #
 
     def _ensure_thread(self) -> None:
-        with self._lock:
+        with sched_points.guard(self._lock, "writer.lock"):
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._run, name="rollout-jsonl-writer", daemon=True
                 )
                 self._thread.start()
+                # adopt the new thread into an active deterministic
+                # scheduler before it does any observable work
+                sched_points.announce_thread(self._thread)
+
+    def _join_queue(self) -> None:
+        """Wait until the queue drains; under the deterministic scheduler
+        a blocking ``Queue.join`` would stall the schedule, so poll and
+        yield instead (the writer thread only makes progress while the
+        scheduler runs it)."""
+        if self._thread is None:
+            return
+        if sched_points.instrumented():
+            while self._q.unfinished_tasks:
+                sched_points.yield_point("writer.flush.wait")
+            return
+        self._q.join()
 
     def _raise_pending(self) -> None:
-        if self._error is not None:
+        # the swap must hold _lock: _error is written by the writer
+        # thread (_run's except / _on_write_failure) and consumed here on
+        # the caller thread — an unlocked test-then-swap can both lose an
+        # error and double-raise one
+        with sched_points.guard(self._lock, "writer.lock"):
             err, self._error = self._error, None
+        if err is not None:
             raise RuntimeError(
                 "background rollout writer failed; rows after the failure "
                 "may be missing"
             ) from err
 
     def _append(self, path: str, lines: List[str]) -> None:
+        sched_points.yield_point("writer.append")
         chaos.check("writer.write")
         with open(path, "a") as f:
             f.write("\n".join(lines) + "\n")
@@ -165,7 +206,9 @@ class BackgroundJSONLWriter:
         self, batch: Tuple[str, List[str]], error: BaseException
     ) -> None:
         """Classify one failed batch: transient ⇒ buffer for retry (and
-        maybe degrade), permanent ⇒ pend the error (old behavior)."""
+        maybe degrade), permanent ⇒ pend the error (old behavior).
+        Caller must hold ``_lock`` (only ``_write_buffered`` calls this,
+        from inside its critical section)."""
         if (
             isinstance(error, Exception)
             and classify_io_error(error) == "transient"
@@ -199,7 +242,7 @@ class BackgroundJSONLWriter:
         """Retry buffered batches in order, then (optionally) one new
         batch; the first failure re-buffers the remainder so ordering
         survives a still-broken disk."""
-        with self._lock:
+        with sched_points.guard(self._lock, "writer.lock"):
             work = self._retry
             self._retry = []
             if then is not None:
@@ -214,17 +257,33 @@ class BackgroundJSONLWriter:
                     self._retry.extend(work[i + 1:])
                     return
 
+    def _get_next(self) -> Optional[Tuple[str, List[str]]]:
+        """Next queue item; under the deterministic scheduler a blocking
+        ``get`` would park the writer thread inside C code where the
+        scheduler cannot preempt it, so poll-and-yield instead."""
+        if sched_points.instrumented():
+            while True:
+                try:
+                    return self._q.get_nowait()
+                except queue.Empty:
+                    sched_points.yield_point("writer.idle")
+        return self._q.get()
+
     def _run(self) -> None:
         while True:
-            item = self._q.get()
+            sched_points.yield_point("writer.loop")
+            item = self._get_next()
             if item is None:
                 self._q.task_done()
                 return
             try:
-                if self._error is None:
+                with sched_points.guard(self._lock, "writer.lock"):
+                    pending = self._error is not None
+                if not pending:
                     self._write_buffered(then=item)
             except BaseException as e:  # surfaced at the next flush/submit
-                if self._error is None:
-                    self._error = e
+                with sched_points.guard(self._lock, "writer.lock"):
+                    if self._error is None:
+                        self._error = e
             finally:
                 self._q.task_done()
