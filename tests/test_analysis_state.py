@@ -1,0 +1,580 @@
+"""Engine 15: checkpoint/resume state-coverage auditor (``--resume-audit``).
+
+Static half: seeded/clean source pairs per rule, inheritance-aware
+carry resolution, contract-hygiene (stale entries, package guard),
+inline-suppression round-trips, and a clean-tree pin. Manifest half:
+synthetic drift detection in every direction plus relock hygiene
+(foreign budget sections stay byte-identical, cross-mesh partial
+relocks and dirty-tree relocks are refused before any write). Dynamic
+half: one real kill/resume differ on the cheapest trainer as a tier-1
+canary, planted-gap localization, and resume-parity regression units
+for the host-state carriers this PR added (drafter EWMAs, QoS
+scheduler quota/seq, health monitor detectors). The full 4-trainer
+matrix and the planted dynamic differ run on the nightly ``slow``
+tier.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from trlx_tpu.analysis import state_audit
+from trlx_tpu.analysis.findings import Finding, filter_suppressed
+from trlx_tpu.analysis.state_audit import (
+    DifferRun,
+    _PLANT_LINE,
+    check_state_manifest,
+    classify_surface,
+    divergence_findings,
+    lint_resume_state,
+    make_state_manifest,
+    plant_gap_paths,
+)
+
+RULES = (
+    "resume-state-gap",
+    "stale-state-contract",
+    "ckpt-schema-drift",
+    "resume-divergence",
+)
+
+MESH = {"dp": 1, "tp": 1}
+
+
+# --------------------------- registry ------------------------------ #
+
+
+def test_rules_registered():
+    from trlx_tpu.analysis.registry import all_rules
+
+    by_id = {r.id: r for r in all_rules()}
+    for rule in RULES:
+        assert rule in by_id
+    assert by_id["resume-state-gap"].severity == "error"
+    assert by_id["ckpt-schema-drift"].severity == "error"
+    assert by_id["resume-divergence"].severity == "error"
+    assert by_id["stale-state-contract"].severity == "warning"
+
+
+# ------------------------- static: per-rule pairs ------------------- #
+
+
+def _classify_source(tmp_path, source, name="mod.py", contracts=None):
+    path = tmp_path / name
+    path.write_text(source)
+    return classify_surface(paths=[str(path)], extra_contracts=contracts)
+
+
+GAP_SOURCE = """\
+class Sampler:
+    def __init__(self):
+        self.cursor = 0
+
+    def next_seed(self):
+        self.cursor += 1
+        return self.cursor
+"""
+
+CARRIED_SOURCE = """\
+class Sampler:
+    def __init__(self):
+        self.cursor = 0
+
+    def next_seed(self):
+        self.cursor += 1
+        return self.cursor
+
+    def state_dict(self):
+        return {"cursor": self.cursor}
+"""
+
+RECONSTRUCTED_SOURCE = """\
+class Cache:
+    def __init__(self):
+        self.table = None
+
+    def _build_table(self, config):
+        self.table = dict(config)
+"""
+
+
+def test_resume_state_gap_pair(tmp_path):
+    _, findings = _classify_source(tmp_path, GAP_SOURCE, "gap.py")
+    hits = [f for f in findings if f.rule == "resume-state-gap"]
+    assert hits and hits[0].subject == "Sampler.cursor"
+    assert hits[0].line == 6  # the first post-init write site
+
+    classified, findings = _classify_source(
+        tmp_path, CARRIED_SOURCE, "carried.py"
+    )
+    assert not [f for f in findings if f.rule == "resume-state-gap"]
+    by_attr = {(c.cls, c.attr): c.category for c in classified}
+    assert by_attr[("Sampler", "cursor")] == "carried"
+
+
+def test_reconstructed_category(tmp_path):
+    classified, findings = _classify_source(
+        tmp_path, RECONSTRUCTED_SOURCE, "cache.py"
+    )
+    assert not findings
+    by_attr = {(c.cls, c.attr): c.category for c in classified}
+    assert by_attr[("Cache", "table")] == "reconstructed"
+
+
+def test_extra_contract_marks_ephemeral(tmp_path):
+    classified, findings = _classify_source(
+        tmp_path,
+        GAP_SOURCE,
+        "gap.py",
+        contracts={("Sampler", "cursor"): "test fixture"},
+    )
+    assert not [f for f in findings if f.rule == "resume-state-gap"]
+    by_attr = {(c.cls, c.attr): c.category for c in classified}
+    assert by_attr[("Sampler", "cursor")] == "ephemeral"
+
+
+INHERITED_CARRY = """\
+class Base:
+    def state_dict(self):
+        return {"cursor": self.cursor}
+
+class Child(Base):
+    def __init__(self):
+        self.cursor = 0
+
+    def next_seed(self):
+        self.cursor += 1
+"""
+
+
+def test_carry_resolves_through_base_chain(tmp_path):
+    """A write on the subclass is covered by the base's state_dict
+    reference — the resolver must walk the inheritance chain the way
+    PPOTrainer's host_state_dict covers GRPO/seq2seq."""
+    classified, findings = _classify_source(
+        tmp_path, INHERITED_CARRY, "inherit.py"
+    )
+    assert not [f for f in findings if f.rule == "resume-state-gap"]
+    by_attr = {(c.cls, c.attr): c.category for c in classified}
+    assert by_attr[("Child", "cursor")] == "carried"
+
+
+def test_stale_contract_pair(tmp_path):
+    # dead attr on an existing class: fires at the class definition
+    _, findings = _classify_source(
+        tmp_path,
+        GAP_SOURCE,
+        "gap.py",
+        contracts={
+            ("Sampler", "cursor"): "real",
+            ("Sampler", "ghost"): "names an attr that does not exist",
+        },
+    )
+    stale = [f for f in findings if f.rule == "stale-state-contract"]
+    assert stale and "ghost" in stale[0].message
+    # a contract whose class is absent from a *scoped* scan must not
+    # fire — the shipped EPHEMERAL_CONTRACTS name trainer classes that
+    # are simply out of scope here, not stale
+    _, findings = _classify_source(
+        tmp_path,
+        CARRIED_SOURCE,
+        "carried.py",
+        contracts={("NoSuchClass", "x"): "out of scope"},
+    )
+    assert not [f for f in findings if f.rule == "stale-state-contract"]
+
+
+# ------------------------- suppression ----------------------------- #
+
+
+def test_source_suppression_roundtrip(tmp_path):
+    lines = GAP_SOURCE.splitlines()
+    lines[5] += "  # tpu-lint: disable=resume-state-gap"
+    (tmp_path / "sup.py").write_text("\n".join(lines) + "\n")
+    findings = lint_resume_state(paths=[str(tmp_path / "sup.py")])
+    kept, n_suppressed = filter_suppressed(findings)
+    assert not [f for f in kept if f.rule == "resume-state-gap"]
+    assert n_suppressed == 1
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_suppression_roundtrip_every_rule(tmp_path, rule):
+    """Every engine-15 rule id must round-trip through the shared
+    inline-directive machinery, including the synthetic (differ and
+    manifest) findings once they are anchored to a source line."""
+    anchored = tmp_path / "anchored.py"
+    anchored.write_text(f"x = 1  # tpu-lint: disable={rule}\n")
+    bare = tmp_path / "bare.py"
+    bare.write_text("x = 1\n")
+    mk = lambda p: Finding(  # noqa: E731
+        rule=rule, message="synthetic", file=str(p), line=1
+    )
+    kept, n = filter_suppressed([mk(anchored)])
+    assert kept == [] and n == 1
+    kept, n = filter_suppressed([mk(bare)])
+    assert len(kept) == 1 and n == 0
+
+
+# ------------------------- clean-tree pin --------------------------- #
+
+
+def test_package_static_clean():
+    """The shipped resume surface must stay gap-free, and the walk must
+    actually be classifying a substantial surface across all buckets."""
+    classified, findings = classify_surface()
+    kept, _ = filter_suppressed(findings)
+    assert kept == [], [f.format_text() for f in kept]
+    by_category = {}
+    for c in classified:
+        by_category[c.category] = by_category.get(c.category, 0) + 1
+    for category in ("carried", "carried-via", "ephemeral",
+                     "phase-reset", "reconstructed"):
+        assert by_category.get(category, 0) > 0, by_category
+    assert len(classified) > 100
+    subjects = {f"{c.cls}.{c.attr}" for c in classified}
+    # the carriers this PR added must be visible as carried state
+    for subject in ("NGramDrafter._ewma", "QoSScheduler._seq",
+                    "HealthMonitor.events"):
+        assert subject in subjects
+
+
+# ------------------------- planted gap (static) --------------------- #
+
+
+def test_plant_static_localizes(tmp_path):
+    _, findings = classify_surface(paths=plant_gap_paths(str(tmp_path)))
+    hits = [f for f in findings if f.rule == "resume-state-gap"]
+    assert hits
+    assert hits[0].file.endswith("planted_resume_gap.py")
+    assert hits[0].line == _PLANT_LINE
+    assert hits[0].subject == "PlantedSampler.draws"
+
+
+# ------------------------- manifest drift --------------------------- #
+
+
+def _run(kind="ppo", state=None, metadata=None):
+    run = DifferRun(kind=kind)
+    run.mesh = dict(MESH)
+    run.manifest = {
+        "state": dict(state if state is not None else {"w": "float32[2]"}),
+        "metadata": list(metadata if metadata is not None else ["m.rng"]),
+    }
+    run.compared_paths = 1
+    return run
+
+
+def _locked(runs):
+    return {"state_manifest": make_state_manifest(runs, MESH)}
+
+
+def test_manifest_clean_match():
+    runs = [_run()]
+    assert check_state_manifest(runs, _locked(runs), MESH) == []
+
+
+def test_manifest_missing_section():
+    findings = check_state_manifest([_run()], {}, MESH)
+    assert len(findings) == 1
+    assert findings[0].rule == "ckpt-schema-drift"
+    assert "no state_manifest section" in findings[0].message
+
+
+def test_manifest_mesh_mismatch():
+    findings = check_state_manifest(
+        [_run()], _locked([_run()]), {"dp": 2, "tp": 1}
+    )
+    assert len(findings) == 1
+    assert "not comparable" in findings[0].message
+
+
+def test_manifest_leaf_drift_every_direction():
+    locked = _locked([_run(state={"w": "float32[2]", "b": "float32[4]"},
+                           metadata=["m.rng", "m.kl"])])
+    # vanished leaf + changed dtype + new leaf + vanished/new metadata
+    runs = [_run(state={"w": "bfloat16[2]", "extra": "int32[1]"},
+                 metadata=["m.rng", "m.new"])]
+    findings = check_state_manifest(runs, locked, MESH)
+    by_subject = {f.subject: f.message for f in findings}
+    assert "vanished" in by_subject["ppo:b"]
+    assert "changed float32[2] -> bfloat16[2]" in by_subject["ppo:w"]
+    assert "new checkpoint leaf" in by_subject["ppo:extra"]
+    assert "vanished from _save_metadata" in by_subject["ppo:m.kl"]
+    assert "new host-metadata key" in by_subject["ppo:m.new"]
+    assert all(f.rule == "ckpt-schema-drift" for f in findings)
+
+
+def test_manifest_unaudited_kind_required():
+    locked = _locked([_run(kind="ppo")])
+    findings = check_state_manifest([_run(kind="ilql")], locked, MESH)
+    assert any("no committed state manifest" in f.message
+               for f in findings)
+
+
+def test_manifest_stale_locked_kind():
+    locked = _locked([_run(kind="ppo"), _run(kind="bogus")])
+    findings = check_state_manifest([_run(kind="ppo")], locked, MESH)
+    stale = [f for f in findings if f.rule == "stale-state-contract"]
+    assert stale and "bogus" in stale[0].message
+
+
+# ------------------------- differ findings -------------------------- #
+
+
+def test_divergence_findings_shape():
+    run = DifferRun(kind="ilql")
+    run.divergences = [("trainer.x.y", "1", "2")]
+    findings = divergence_findings(run)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "resume-divergence"
+    assert f.subject == "ilql:trainer.x.y"
+    assert "trainer.x.y" in f.message
+    assert divergence_findings(DifferRun(kind="ilql")) == []
+
+
+# ---------------------- relock refusal (no write) -------------------- #
+
+
+def _stub_differ(manifest=None, divergences=()):
+    def stub(kind, mesh=None, plant_gap=False, workdir=None):
+        run = DifferRun(kind=kind)
+        run.mesh = dict(MESH)
+        run.manifest = manifest or {
+            "state": {"w": "float32[2]"}, "metadata": ["m.rng"],
+        }
+        run.compared_paths = 1
+        run.divergences = list(divergences)
+        return run
+
+    return stub
+
+
+def test_partial_relock_cross_mesh_refused(tmp_path, monkeypatch):
+    budgets_path = tmp_path / "budgets.json"
+    before = json.dumps(
+        {"foreign": {"keep": 1},
+         "state_manifest": {"mesh": {"dp": 99}, "trainers": {}}},
+        indent=2, sort_keys=True,
+    ) + "\n"
+    budgets_path.write_text(before)
+    monkeypatch.setattr(state_audit, "run_resume_differ", _stub_differ())
+    report, _ = state_audit.audit_resume_state(
+        kinds=("ilql",), update=True, budgets_path=str(budgets_path)
+    )
+    assert any("refusing" in f.message and f.rule == "ckpt-schema-drift"
+               for f in report.findings)
+    assert budgets_path.read_text() == before  # nothing was written
+
+
+def test_relock_refused_before_write_on_findings(tmp_path, monkeypatch):
+    budgets_path = tmp_path / "budgets.json"
+    before = json.dumps({"foreign": {"keep": 1}},
+                        indent=2, sort_keys=True) + "\n"
+    budgets_path.write_text(before)
+    monkeypatch.setattr(
+        state_audit, "run_resume_differ",
+        _stub_differ(divergences=[("trainer.x", "1", "2")]),
+    )
+    report, _ = state_audit.audit_resume_state(
+        update=True, budgets_path=str(budgets_path)
+    )
+    assert any(f.rule == "resume-divergence" for f in report.findings)
+    assert budgets_path.read_text() == before  # refusal precedes write
+
+
+def test_partial_relock_preserves_other_kinds(tmp_path, monkeypatch):
+    """Relocking one trainer must keep every other trainer's locked
+    manifest and every foreign budget section untouched."""
+    budgets_path = tmp_path / "budgets.json"
+    locked_ppo = {"state": {"old": "float32[8]"}, "metadata": ["m.kl"]}
+    budgets_path.write_text(json.dumps(
+        {"foreign": {"keep": 1},
+         "state_manifest": {"mesh": dict(MESH),
+                            "trainers": {"ppo": locked_ppo}}},
+        indent=2, sort_keys=True,
+    ) + "\n")
+    monkeypatch.setattr(state_audit, "run_resume_differ", _stub_differ())
+    report, _ = state_audit.audit_resume_state(
+        kinds=("ilql",), update=True, budgets_path=str(budgets_path)
+    )
+    assert report.findings == []
+    after = json.loads(budgets_path.read_text())
+    assert after["foreign"] == {"keep": 1}
+    assert after["state_manifest"]["trainers"]["ppo"] == locked_ppo
+    assert after["state_manifest"]["trainers"]["ilql"]["state"] == {
+        "w": "float32[2]"
+    }
+
+
+# --------------------- carrier parity regressions -------------------- #
+
+
+def test_drafter_state_roundtrip():
+    from trlx_tpu.serving.spec_drafter import NGramDrafter
+
+    src = NGramDrafter(min_accept_ewma=0.4)
+    src.observe_context(0, [1, 2, 3])
+    for _ in range(6):
+        src.observe_accept(0, n_proposed=4, n_accepted=0)
+    assert src._degraded(0)  # drained EWMA arms the probe counter
+    assert src._ewma and src._suppressed  # the schedule state moved
+    state = json.loads(json.dumps(src.state_dict()))  # ckpt-metadata safe
+    dst = NGramDrafter(min_accept_ewma=0.4)
+    dst.load_state_dict(state)
+    assert dst._ewma == src._ewma
+    assert dst._suppressed == src._suppressed
+
+
+def test_token_bucket_level_carries_without_spurious_refill():
+    from trlx_tpu.serving.scheduler import TokenBucket
+
+    bucket = TokenBucket(rate=1.0, burst=10.0)
+    bucket.refill(0.0)
+    assert bucket.try_charge(4.0, now=0.0)
+    state = bucket.state_dict()
+    assert set(state) == {"level"}  # the monotonic anchor must NOT travel
+    restored = TokenBucket(rate=1.0, burst=10.0)
+    restored.load_state_dict(json.loads(json.dumps(state)))
+    assert restored.level == 6.0
+    # the first post-restore refill re-anchors on the *new* clock
+    # without granting credit for the dead process's wall time
+    restored.refill(1000.0)
+    assert restored.level == 6.0
+    restored.refill(1001.0)
+    assert restored.level == 7.0
+    # a level locked above the (possibly lowered) burst clamps down
+    shrunk = TokenBucket(rate=1.0, burst=3.0)
+    shrunk.load_state_dict({"level": 6.0})
+    assert shrunk.level == 3.0
+
+
+def test_qos_scheduler_state_roundtrip():
+    from trlx_tpu.serving.scheduler import (
+        QoSScheduler,
+        Request,
+        TenantConfig,
+    )
+
+    tenants = {"t": TenantConfig(name="t", rate=1.0, burst=10.0)}
+
+    def _req(i):
+        return Request(request_id=i, tenant="t", prompt_ids=None,
+                       prompt_mask=None, cost=2.0)
+
+    src = QoSScheduler(tenants=dict(tenants), clock=lambda: 1.0)
+    for i in range(3):
+        src.submit(_req(i))
+    bucket = src._bucket("t")
+    bucket.refill(0.0)
+    assert bucket.try_charge(4.0, now=0.0)
+    src.admitted = 2
+    state = json.loads(json.dumps(src.state_dict()))
+    assert "queues" not in state  # drained at phase boundaries by contract
+
+    dst = QoSScheduler(tenants=dict(tenants), clock=lambda: 1.0)
+    dst.load_state_dict(state)
+    assert dst._seq == 3 and dst.admitted == 2
+    assert dst._bucket("t").level == 6.0
+    # the tie-break keeps counting where the dead process stopped
+    assert dst.submit(_req(99)).seq == 3
+
+
+def test_health_monitor_state_roundtrip():
+    from trlx_tpu.telemetry.health import HealthConfig, HealthMonitor
+
+    config = HealthConfig(enabled=True, window=4, warmup=2)
+    src = HealthMonitor(config)
+    for step, loss in enumerate([1.0, 1.1, 0.9, 1.0, 1.05]):
+        src.observe({"loss": loss, "grad_norm": loss * 2}, step=step)
+    state = json.loads(json.dumps(src.state_dict()))  # ckpt-metadata safe
+
+    dst = HealthMonitor(config)
+    dst.load_state_dict(state)
+    assert dst.state_dict() == src.state_dict()
+    # a resumed monitor must react to the next observation exactly like
+    # the uninterrupted one — warmup/EWMA/cooldown all carried
+    ev_src = src.observe({"loss": 1.02, "grad_norm": 2.0}, step=5)
+    ev_dst = dst.observe({"loss": 1.02, "grad_norm": 2.0}, step=5)
+    assert [e.to_dict() for e in ev_dst] == [e.to_dict() for e in ev_src]
+    assert dst.state_dict() == src.state_dict()
+
+
+# --------------------- tier-1 differ canary (real) -------------------- #
+
+
+@pytest.fixture(scope="module")
+def ilql_relock(tmp_path_factory):
+    """One real kill/resume differ on the cheapest trainer, run through
+    the relock path against a copy of the committed lockfile. Shared by
+    the canary/hygiene/plumbing tests below so tier-1 pays for exactly
+    one differ."""
+    from trlx_tpu.analysis.resource_audit import default_budgets_path
+
+    workdir = tmp_path_factory.mktemp("relock")
+    budgets_path = str(workdir / "budgets.json")
+    shutil.copyfile(default_budgets_path(), budgets_path)
+    with open(budgets_path) as f:
+        before = f.read()
+    report, result = state_audit.audit_resume_state(
+        kinds=("ilql",), update=True, budgets_path=budgets_path
+    )
+    with open(budgets_path) as f:
+        after = f.read()
+    return report, result, before, after
+
+
+def test_differ_canary_ilql(ilql_relock):
+    report, result, _, _ = ilql_relock
+    assert report.findings == [], [f.format_text() for f in report.findings]
+    (run,) = result.runs
+    assert run.kind == "ilql"
+    assert run.divergences == [], run.divergences[:5]
+    assert run.compared_paths > 250
+    assert run.manifest["state"]
+    assert "rng_key" in run.manifest["metadata"]
+    assert result.mesh  # measured from the live trainer's mesh
+
+
+def test_relock_is_byte_stable(ilql_relock):
+    """Relocking the same trainer on the same mesh over unchanged code
+    must reproduce the committed lockfile byte-for-byte — foreign
+    engine sections AND the other trainers' manifests included."""
+    _, _, before, after = ilql_relock
+    assert "state_manifest" in json.loads(before)  # committed lock present
+    assert after == before
+
+
+def test_audit_report_plumbing(ilql_relock):
+    report, result, _, _ = ilql_relock
+    assert report.exit_code(strict=True) == 0
+    assert any(c.startswith("state:") for c in report.covered)
+    assert any(c.startswith("differ:ilql:") for c in report.covered)
+    assert any(c.startswith("manifest:ilql:") for c in report.covered)
+    assert any(c.startswith("manifest-meta:ilql:") for c in report.covered)
+    payload = result.to_json()
+    assert payload["classified_attrs"] == len(result.classified)
+    assert payload["differ"][0]["kind"] == "ilql"
+
+
+# ------------------------- nightly full sweep ------------------------ #
+
+
+@pytest.mark.slow  # full 4-trainer kill/resume matrix: nightly tier
+def test_full_resume_matrix():
+    report, result = state_audit.audit_resume_state()
+    assert report.findings == [], [f.format_text() for f in report.findings]
+    assert {r.kind for r in result.runs} == {"ppo", "ilql", "grpo",
+                                             "seq2seq"}
+    for run in result.runs:
+        assert run.divergences == [], (run.kind, run.divergences[:5])
+        assert run.compared_paths > 250
+
+
+@pytest.mark.slow  # second differ build+restore cycle: nightly tier
+def test_planted_differ_diverges():
+    run = state_audit.run_resume_differ("ilql", plant_gap=True)
+    paths = [p for p, _, _ in run.divergences]
+    assert "trainer._planted_schedule.draws" in paths

@@ -172,6 +172,30 @@ def main(argv=None) -> int:
         "collective-divergence mesh matrix (faster; less coverage)",
     )
     parser.add_argument(
+        "--resume-audit",
+        action="store_true",
+        help="instead of the rule engines: checkpoint/resume "
+        "state-coverage audit — statically classify every mutable "
+        "attribute on the trainer-reachable surface as "
+        "checkpoint-carried / config-reconstructed / allowlisted "
+        "ephemeral (resume-state-gap on anything else), run a "
+        "kill/resume differ per trainer (checkpoint at a phase "
+        "boundary, rebuild + restore, one more phase vs an "
+        "uninterrupted twin, deep-compare the full live attribute "
+        "trees: resume-divergence), and gate the checkpoint schema "
+        "against the state_manifest section of analysis/budgets.json "
+        "(ckpt-schema-drift; --update-budgets relocks)",
+    )
+    parser.add_argument(
+        "--plant-gap",
+        action="store_true",
+        help="with --resume-audit: plant an uncheckpointed counter "
+        "threaded into the sampling schedule — self-check that the "
+        "static half names resume-state-gap at the planted file:line "
+        "AND the differ names the divergent attribute path; schema "
+        "gating is skipped; exit must be 1",
+    )
+    parser.add_argument(
         "--resources",
         action="store_true",
         help="instead of the rule engines: compute static peak-HBM / "
@@ -405,6 +429,38 @@ def main(argv=None) -> int:
             # findings here mean the update was REFUSED (rule findings
             # on the tree, or a cross-mesh partial relock) and nothing
             # was written
+            return 1 if report.findings else 0
+        return report.exit_code(strict=args.strict)
+
+    if args.resume_audit or args.plant_gap:
+        _force_cpu_platform()
+        from trlx_tpu.analysis.state_audit import (
+            audit_resume_state,
+            format_state_text,
+        )
+
+        report, result = audit_resume_state(
+            kinds=trainers,
+            mesh=mesh,
+            budgets_path=args.budgets,
+            update=args.update_budgets,
+            plant_gap=args.plant_gap,
+        )
+        if args.json:
+            print(report.to_json())
+        else:
+            print(format_state_text(result))
+            if args.update_budgets and not report.findings:
+                print(
+                    "state manifest written — review and commit the "
+                    "lockfile diff"
+                )
+            if report.findings:
+                print(report.format_text())
+        if args.update_budgets:
+            # findings here mean the update was REFUSED (gap/divergence
+            # findings on the tree, or a cross-mesh partial relock) and
+            # nothing trustworthy was written
             return 1 if report.findings else 0
         return report.exit_code(strict=args.strict)
 

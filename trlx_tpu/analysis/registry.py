@@ -27,6 +27,7 @@ ENGINE_PERF = "perf"
 ENGINE_LOCKSTEP = "lockstep"
 ENGINE_HLO = "hlo"
 ENGINE_CONCURRENCY = "concurrency"
+ENGINE_STATE = "state"
 
 
 @dataclass(frozen=True)
@@ -545,6 +546,62 @@ register_rule(Rule(
     "scheduler runs the REAL writer/drive/push/pump code under seeded "
     "interleavings and replays the first violating schedule by seed — "
     "a race gate the 13 jaxpr/HLO-level engines cannot provide.",
+))
+
+# ---------------- checkpoint/resume state coverage (engine 15) ----------- #
+
+register_rule(Rule(
+    "resume-state-gap",
+    ENGINE_STATE,
+    "every mutable attribute written inside the phase loop on an object "
+    "reachable from a trainer is checkpoint-carried, deterministically "
+    "reconstructed from config on restore, or explicitly allowlisted "
+    "ephemeral with a written justification",
+    SEVERITY_ERROR,
+    "Kill/resume parity is the repo's fault-tolerance contract (PR 9's "
+    "supervisor + emergency checkpoints), but host state grew past the "
+    "save() metadata: an accept-EWMA, token-bucket level, or RNG key "
+    "that feeds the sampling schedule and is silently reset on restore "
+    "makes a resumed run diverge from the uninterrupted one — exactly "
+    "the failure the parity canaries were written to forbid.",
+))
+register_rule(Rule(
+    "stale-state-contract",
+    ENGINE_STATE,
+    "every ephemeral-allowlist entry and state-manifest key names an "
+    "attribute that still exists in the code",
+    SEVERITY_WARNING,
+    "A contract naming a dead attribute is worse than no contract: the "
+    "attribute was renamed or removed, the justification no longer "
+    "covers anything, and the next writer inherits a green audit that "
+    "is vacuously true. Stale entries must be pruned or renamed so the "
+    "allowlist stays a live inventory, not a fossil record.",
+))
+register_rule(Rule(
+    "ckpt-schema-drift",
+    ENGINE_STATE,
+    "each trainer's checkpoint key-set and per-leaf shape/dtype "
+    "fingerprint matches the locked state_manifest section of "
+    "analysis/budgets.json",
+    SEVERITY_ERROR,
+    "A key that vanishes from the save pytree is a resume gap the "
+    "static classifier cannot see (the state_dict method still "
+    "exists), and a shape/dtype change breaks restore of every "
+    "checkpoint already on disk. Locking the schema makes either "
+    "drift a reviewed, additive relock instead of a silent break.",
+))
+register_rule(Rule(
+    "resume-divergence",
+    ENGINE_STATE,
+    "after checkpoint -> rebuild -> restore, one more phase of the "
+    "resumed trainer leaves every live host attribute bitwise equal to "
+    "an uninterrupted twin's (outside the allowlisted ephemeral set)",
+    SEVERITY_ERROR,
+    "The dynamic half of the contract: static classification proves an "
+    "attribute is carried, only the differ proves it is carried "
+    "*correctly* (right tensor, right dtype, restored before first "
+    "use). Any diverging attribute path is a real parity break that "
+    "the params-only canaries would miss.",
 ))
 
 # ---------------------------- AST-lint rules ----------------------------- #

@@ -31,7 +31,6 @@ Host-only, stdlib + the metrics registry; no jax at import time.
 from __future__ import annotations
 
 import heapq
-import itertools
 import math
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -94,6 +93,21 @@ class TokenBucket:
             return False
         self.level -= cost
         return True
+
+    def state_dict(self) -> Dict[str, float]:
+        """Resume-carried quota state. Only ``level`` travels: the
+        refill anchor is a *monotonic* timestamp that does not survive
+        a process restart, so restoring it raw would either grant a
+        huge spurious refill (new clock ahead) or freeze refills (new
+        clock behind). Dropping it back to the -1 sentinel makes the
+        first post-restore ``refill`` re-anchor without adding credit —
+        the drained-tenant throttle the level encodes carries across
+        the kill, which is the part that feeds the admission schedule."""
+        return {"level": float(self.level)}
+
+    def load_state_dict(self, state: Dict[str, float]) -> None:
+        self.level = min(float(state["level"]), self.burst)
+        self.last_refill = -1.0
 
 
 @dataclass(frozen=True)
@@ -175,7 +189,9 @@ class QoSScheduler:
         self.registry = registry
         self._queues: Dict[str, List[Request]] = {}
         self._buckets: Dict[str, TokenBucket] = {}
-        self._seq = itertools.count()
+        # plain int (not itertools.count) so the submission-order
+        # tie-break survives checkpoint/resume via state_dict()
+        self._seq = 0
         self.admitted = 0
         self.throttled_rounds = 0  # quota skips (observability)
 
@@ -225,7 +241,8 @@ class QoSScheduler:
         """Enqueue; fills scheduler-owned fields (seq, submitted_at,
         defaults inherited from the tenant's config)."""
         self.validate(request)
-        request.seq = next(self._seq)
+        request.seq = self._seq
+        self._seq += 1
         if request.submitted_at <= 0:
             request.submitted_at = self.clock()
         self._queues.setdefault(request.tenant, []).append(request)
@@ -237,6 +254,37 @@ class QoSScheduler:
 
     def has_work(self) -> bool:
         return any(self._queues.values())
+
+    # --------------------------- checkpointing -------------------------- #
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Resume-carried scheduler state: per-tenant bucket levels (a
+        drained tenant must stay throttled across the kill), the global
+        submission sequence (the final deterministic tie-break — a
+        reset would let post-resume requests reorder against any the
+        caller re-submits), and the admission counters. Queues are NOT
+        carried: the preemption contract drains in-flight requests at
+        phase boundaries, so at any checkpointable point they are
+        empty; dynamically registered default tenants re-register on
+        first touch."""
+        return {
+            "seq": int(self._seq),
+            "admitted": int(self.admitted),
+            "throttled_rounds": int(self.throttled_rounds),
+            "buckets": {
+                tenant: bucket.state_dict()
+                for tenant, bucket in sorted(self._buckets.items())
+            },
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._seq = int(state["seq"])
+        self.admitted = int(state["admitted"])
+        self.throttled_rounds = int(state["throttled_rounds"])
+        for tenant, bucket_state in state["buckets"].items():
+            bucket = self._bucket(tenant)
+            if bucket is not None:
+                bucket.load_state_dict(bucket_state)
 
     # ------------------------------ policy ----------------------------- #
 

@@ -341,6 +341,58 @@ class HealthMonitor:
             merged.update(self.config.detectors.get(did, {}))
             self._specs[did] = merged
 
+    # ---------------------------- checkpointing ---------------------------- #
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Resume-carried detector state. The EWMA baselines, warmup
+        counts, flatline runs, and cooldown horizons all feed whether
+        the next observation trips an event: a monitor rebuilt empty
+        after a supervisor restart would re-warm from scratch and stay
+        silent through exactly the post-resume steps most likely to
+        regress. Everything here is host JSON scalars — safe for the
+        checkpoint metadata pickle."""
+        return {
+            "series": {
+                key: {
+                    "count": st.count,
+                    "mean": st.mean,
+                    "var": st.var,
+                    "window": list(st.window),
+                    "flat_run": st.flat_run,
+                }
+                for key, st in sorted(self._series.items())
+            },
+            "quiet": [
+                [detector, series, horizon]
+                for (detector, series), horizon in sorted(self._quiet.items())
+            ],
+            "observations": self._observations,
+            "event_counts": dict(self.event_counts),
+            "latest": dict(self.latest),
+            "events": [ev.to_dict() for ev in self.events],
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._series = {}
+        for key, st_state in state["series"].items():
+            st = _SeriesState(self.config.window)
+            st.count = int(st_state["count"])
+            st.mean = float(st_state["mean"])
+            st.var = float(st_state["var"])
+            st.window.extend(float(v) for v in st_state["window"])
+            st.flat_run = int(st_state["flat_run"])
+            self._series[key] = st
+        self._quiet = {
+            (detector, series): int(horizon)
+            for detector, series, horizon in state["quiet"]
+        }
+        self._observations = int(state["observations"])
+        self.event_counts = {
+            k: int(v) for k, v in state["event_counts"].items()
+        }
+        self.latest = {k: float(v) for k, v in state["latest"].items()}
+        self.events = [HealthEvent(**ev) for ev in state["events"]]
+
     # ------------------------------ internals ----------------------------- #
 
     def _state(self, key: str) -> _SeriesState:

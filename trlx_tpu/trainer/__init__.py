@@ -579,6 +579,35 @@ class BaseRLTrainer(ABC):
     @abstractmethod
     def load(self, directory: str) -> None: ...
 
+    # --- host-state resume contract ------------------------------------ #
+
+    def host_state_dict(self) -> Dict[str, Any]:
+        """Mutable *host* state that must survive kill/resume but lives
+        outside the device pytree: every subclass folds its own entries
+        on top of this dict and the result rides the checkpoint
+        ``metadata`` pickle. The checkpoint/resume auditor (engine 15,
+        ``python -m trlx_tpu.analysis --resume-audit``) statically
+        requires each phase-loop-mutated attribute to be reachable from
+        here, reconstructed from config, or allowlisted ephemeral — add
+        new mutable schedule state to this dict, not just to save().
+
+        The base contribution is the health-detector engine: its EWMA
+        baselines and cooldowns decide post-resume alerting (see
+        HealthMonitor.state_dict)."""
+        state: Dict[str, Any] = {}
+        if self.health_monitor is not None:
+            state["health_monitor"] = self.health_monitor.state_dict()
+        return state
+
+    def load_host_state_dict(self, state: Dict[str, Any]) -> None:
+        """Inverse of :meth:`host_state_dict`; tolerates missing keys so
+        checkpoints written before a given piece of state existed still
+        restore (the schema lock in analysis/budgets.json makes any
+        *removal* loud instead)."""
+        monitor_state = state.get("health_monitor")
+        if monitor_state is not None and self.health_monitor is not None:
+            self.health_monitor.load_state_dict(monitor_state)
+
     # --- shared host-side text boundary -------------------------------- #
 
     def apply_tokenizer_gen_defaults(self, gen_kwargs: Dict[str, Any]) -> None:

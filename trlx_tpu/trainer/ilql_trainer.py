@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, Optional
 import flax.struct as struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -660,10 +661,24 @@ class ILQLTrainer(BaseRLTrainer):
         save_checkpoint(
             directory or self.config.train.checkpoint_dir,
             self.state,
-            metadata={},
+            metadata=self._save_metadata(),
             async_save=self.config.train.async_checkpoint,
             step=step,
         )
+
+    def _save_metadata(self) -> Dict[str, Any]:
+        """Host-metadata pytree (JSON-safe; see the resume auditor's
+        ``state_manifest`` lock)."""
+        return {
+            # sample() splits self.rng per call: without carrying the
+            # chain, a resumed run's post-resume samples would replay
+            # the seed-time keys and diverge from the uninterrupted run
+            # (the resume-state gap engine 15's differ pins)
+            "rng_key": np.asarray(jax.device_get(self.rng))
+            .ravel()
+            .tolist(),
+            "host_state": self.host_state_dict(),
+        }
 
     def load(self, directory: str) -> None:
         abstract = jax.tree_util.tree_map(
@@ -671,4 +686,12 @@ class ILQLTrainer(BaseRLTrainer):
             self.state,
             self.state_shardings,
         )
-        self.state, _ = load_checkpoint(directory, abstract)
+        self.state, meta = load_checkpoint(directory, abstract)
+        rng_key = meta.get("rng_key")
+        if rng_key is not None:
+            self.rng = jnp.asarray(
+                np.asarray(rng_key, dtype=np.uint32).reshape(
+                    np.shape(self.rng)
+                )
+            )
+        self.load_host_state_dict(meta.get("host_state") or {})

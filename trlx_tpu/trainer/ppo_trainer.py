@@ -2224,32 +2224,87 @@ class PPOTrainer(BaseRLTrainer):
 
     # ------------------------------------------------------------------ #
 
+    def host_state_dict(self) -> Dict[str, Any]:
+        state = super().host_state_dict()
+        # per-row RNG phase state: mid-phase the lazily split phase key
+        # and draw cursor decide every remaining row's fold_in key, so
+        # a boundary-agnostic checkpoint must carry them (at a phase
+        # boundary they are just None/0 and the entry is inert)
+        if self._rollout_phase_key is not None:
+            state["rollout_phase_key"] = (
+                np.asarray(jax.device_get(self._rollout_phase_key))
+                .ravel()
+                .tolist()
+            )
+        state["rollout_row_cursor"] = int(self._rollout_row_cursor)
+        # continuous-engine drafter: accept-EWMA/probe counters feed the
+        # drafting schedule (spec_drafter.state_dict); only present once
+        # the engine has been built — a never-built engine has no
+        # drafter state worth carrying
+        engine = self._rollout_engine_obj
+        drafter = getattr(engine, "spec_drafter", None)
+        if drafter is not None and hasattr(drafter, "state_dict"):
+            state["spec_drafter"] = drafter.state_dict()
+        return state
+
+    def load_host_state_dict(self, state: Dict[str, Any]) -> None:
+        super().load_host_state_dict(state)
+        phase_key = state.get("rollout_phase_key")
+        if phase_key is not None:
+            self._rollout_phase_key = jnp.asarray(
+                np.asarray(phase_key, dtype=np.uint32)
+            )
+        self._rollout_row_cursor = int(
+            state.get("rollout_row_cursor", self._rollout_row_cursor)
+        )
+        drafter_state = state.get("spec_drafter")
+        if drafter_state is not None and self.rollout_engine == "continuous":
+            # building the engine here is fine: a resumed
+            # continuous-engine run needs it before the first phase
+            # anyway, and restoring the drafter EWMAs after that first
+            # phase would be too late
+            drafter = getattr(self.rollout_engine_obj, "spec_drafter", None)
+            if drafter is not None and hasattr(drafter, "load_state_dict"):
+                drafter.load_state_dict(drafter_state)
+
+    def _save_metadata(self) -> Dict[str, Any]:
+        """The checkpoint's host-metadata pytree (JSON-safe). Split out
+        of save() so the resume auditor (engine 15) can fingerprint the
+        metadata schema for the ``state_manifest`` lock without writing
+        a checkpoint."""
+        # one batched fetch for all host-side save inputs
+        kl_coef, mean_kl, rng = jax.device_get(
+            (self.kl_coef, self.mean_kl, self.rng)
+        )
+        metadata = {
+            "kl_coef": float(kl_coef),
+            "mean_kl": float(mean_kl),
+            # the sampler RNG chain: one split per phase (plus one
+            # per chunk without per-row RNG) — restoring it exactly
+            # is half of kill/resume bitwise parity; the other half
+            # is the orchestrator state below (docs/resilience.md)
+            "rng_key": np.asarray(rng).ravel().tolist(),
+            # everything else mutable-but-host-side (drafter EWMAs,
+            # health detectors, mid-phase RNG cursor) rides the
+            # host-state contract audited by engine 15
+            "host_state": self.host_state_dict(),
+        }
+        orch = getattr(self, "orch", None)
+        if orch is not None and hasattr(orch, "state_dict"):
+            # reward-scaling running moments + prompt-stream position
+            metadata["orchestrator"] = orch.state_dict()
+        return metadata
+
     def save(self, directory: Optional[str] = None) -> None:
         directory = directory or self.config.train.checkpoint_dir
         with telemetry.span("phase/checkpoint"):
-            # one batched fetch for all host-side save inputs
-            kl_coef, mean_kl, step, rng = jax.device_get(
-                (self.kl_coef, self.mean_kl, self.state.step, self.rng)
-            )
-            metadata = {
-                "kl_coef": float(kl_coef),
-                "mean_kl": float(mean_kl),
-                # the sampler RNG chain: one split per phase (plus one
-                # per chunk without per-row RNG) — restoring it exactly
-                # is half of kill/resume bitwise parity; the other half
-                # is the orchestrator state below (docs/resilience.md)
-                "rng_key": np.asarray(rng).ravel().tolist(),
-            }
-            orch = getattr(self, "orch", None)
-            if orch is not None and hasattr(orch, "state_dict"):
-                # reward-scaling running moments + prompt-stream position
-                metadata["orchestrator"] = orch.state_dict()
+            step = int(jax.device_get(self.state.step))
             save_checkpoint(
                 directory,
                 self.state,
-                metadata=metadata,
+                metadata=self._save_metadata(),
                 async_save=self.config.train.async_checkpoint,
-                step=int(step),
+                step=step,
             )
 
     def load(self, directory: str) -> None:
@@ -2272,3 +2327,4 @@ class PPOTrainer(BaseRLTrainer):
         orch = getattr(self, "orch", None)
         if orch_state and orch is not None and hasattr(orch, "load_state_dict"):
             orch.load_state_dict(orch_state)
+        self.load_host_state_dict(meta.get("host_state") or {})
